@@ -1,0 +1,36 @@
+"""raft_tpu — a TPU-native (JAX/XLA) frequency-domain dynamics framework for
+floating offshore wind (and marine hydrokinetic) turbines.
+
+This is a ground-up re-design of the capabilities of WISDEM/RAFT (the
+reference implementation lives at /root/reference; see SURVEY.md for the
+layer map) built TPU-first:
+
+* All physics kernels are pure ``jax.numpy`` functions over pytrees of
+  statically-shaped arrays, so they ``jit``/``vmap`` over frequency,
+  wave heading, load case and *design* axes, and ``shard_map`` over a
+  ``jax.sharding.Mesh`` for pod-scale design sweeps.
+* Model *structure* (member strip discretisation, joint/DOF-reduction
+  topology) is resolved once at build time in Python/numpy, producing the
+  padded tensors and transformation matrices the kernels consume — the
+  moral equivalent of tracing: topology is static, parameters are traced.
+
+Package layout
+--------------
+``raft_tpu.ops``        low-level math kernels (transforms, frustum
+                        integrals, wave kinematics, spectra).
+``raft_tpu.structure``  build-time geometry + topology (schema parsing,
+                        strip discretisation, DOF reduction).
+``raft_tpu.physics``    statics, Morison hydrodynamics, mooring, aero.
+``raft_tpu.models``     FOWT / Model assembly and the dynamics solver.
+``raft_tpu.parallel``   device-mesh sweep drivers (vmap/shard_map).
+"""
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):  # lazy: keep `import raft_tpu.ops` light
+    if name == "Model":
+        from raft_tpu.models.model import Model
+
+        return Model
+    raise AttributeError(name)

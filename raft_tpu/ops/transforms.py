@@ -1,0 +1,177 @@
+"""Rigid-body transform kernels.
+
+Pure jax.numpy implementations of the frame-transform algebra used
+throughout the framework.  Functional equivalents of the reference
+helpers (``/root/reference/raft/helpers.py``: ``getH`` :428,
+``rotationMatrix`` :439, ``translateForce3to6DOF`` :468,
+``translateMatrix3to6DOF`` :537, ``translateMatrix6to6DOF`` :563,
+``rotateMatrix3/6`` :604-655, ``getWeightOfPointMass`` :1060), but
+written as batched, broadcast-friendly ops: every function accepts
+leading batch dimensions on its array arguments so it vmaps for free.
+
+Conventions (matching the reference so golden values carry over):
+* ``skew(r) @ th == cross(th, r)`` — i.e. ``skew`` is the *alternator*
+  matrix H with H[0,1]=r_z, H[0,2]=-r_y, ... (helpers.py:428-437).
+* Small-rotation displacement of a point at ``r`` under rotation vector
+  ``th`` is ``th x r`` = ``skew(r) @ th``.
+* ``rotation_matrix(x3, x2, x1) = Rz(x1) @ Ry(x2) @ Rx(x3)`` —
+  intrinsic z-y-x (yaw-pitch-roll applied in that order).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def skew(r):
+    """Alternator matrix H of a 3-vector: ``H @ v == cross(v, r)``.
+
+    Matches helpers.py:428 ``getH``. Supports leading batch dims:
+    r: (..., 3) -> (..., 3, 3).
+    """
+    r = jnp.asarray(r)
+    z = jnp.zeros_like(r[..., 0])
+    return jnp.stack(
+        [
+            jnp.stack([z, r[..., 2], -r[..., 1]], axis=-1),
+            jnp.stack([-r[..., 2], z, r[..., 0]], axis=-1),
+            jnp.stack([r[..., 1], -r[..., 0], z], axis=-1),
+        ],
+        axis=-2,
+    )
+
+
+def rotation_matrix(x3, x2, x1):
+    """Intrinsic z-y-x rotation matrix; helpers.py:439-466.
+
+    ``R = Rz(x1) Ry(x2) Rx(x3)`` with (x3, x2, x1) ~ (roll, pitch, yaw).
+    Scalar or batched inputs (broadcast against each other).
+    """
+    s1, c1 = jnp.sin(x1), jnp.cos(x1)
+    s2, c2 = jnp.sin(x2), jnp.cos(x2)
+    s3, c3 = jnp.sin(x3), jnp.cos(x3)
+    r00 = c1 * c2
+    r01 = c1 * s2 * s3 - c3 * s1
+    r02 = s1 * s3 + c1 * c3 * s2
+    r10 = c2 * s1
+    r11 = c1 * c3 + s1 * s2 * s3
+    r12 = c3 * s1 * s2 - c1 * s3
+    r20 = -s2
+    r21 = c2 * s3
+    r22 = c2 * c3
+    return jnp.stack(
+        [
+            jnp.stack([r00, r01, r02], axis=-1),
+            jnp.stack([r10, r11, r12], axis=-1),
+            jnp.stack([r20, r21, r22], axis=-1),
+        ],
+        axis=-2,
+    )
+
+
+def small_rotate(r, th):
+    """Displacement of point ``r`` under small rotation vector ``th``:
+    ``th x r``.  Matches helpers.py:396-408 ``SmallRotate``."""
+    return jnp.cross(th, r)
+
+
+def translate_force_3to6(F, r):
+    """Force at point ``r`` -> equivalent 6-DOF force/moment about origin.
+
+    helpers.py:468-483. F: (..., 3), r: (..., 3) -> (..., 6).
+    Works for real or complex F.
+    """
+    return jnp.concatenate([F, jnp.cross(r, F)], axis=-1)
+
+
+def translate_matrix_3to6(M3, r):
+    """3x3 mass-like matrix at point ``r`` -> 6x6 about origin.
+
+    helpers.py:537-560.  M3: (..., 3, 3), r: (..., 3) -> (..., 6, 6).
+    """
+    H = skew(r)
+    MH = M3 @ H
+    top = jnp.concatenate([M3, MH], axis=-1)
+    bot = jnp.concatenate([jnp.swapaxes(MH, -1, -2), H @ M3 @ jnp.swapaxes(H, -1, -2)], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def translate_matrix_6to6(M6, r):
+    """Translate a 6x6 matrix to a new reference point; helpers.py:563-585.
+
+    ``r`` points from the *new* reference point to the old one.
+    """
+    H = skew(r)
+    Ht = jnp.swapaxes(H, -1, -2)
+    m = M6[..., :3, :3]
+    J = M6[..., :3, 3:]
+    Jt = M6[..., 3:, :3]
+    I = M6[..., 3:, 3:]
+    J2 = m @ H + J
+    I2 = H @ m @ Ht + Jt @ H + Ht @ J + I
+    top = jnp.concatenate([m, J2], axis=-1)
+    bot = jnp.concatenate([jnp.swapaxes(J2, -1, -2), I2], axis=-2 + 1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def rotate_matrix_3(M3, R):
+    """``R @ M3 @ R.T``; helpers.py:642-655."""
+    return R @ M3 @ jnp.swapaxes(R, -1, -2)
+
+
+def rotate_matrix_6(M6, R):
+    """Rotate a 6x6 mass/inertia tensor block-wise; helpers.py:604-639."""
+    Rt = jnp.swapaxes(R, -1, -2)
+    m = R @ M6[..., :3, :3] @ Rt
+    J = R @ M6[..., :3, 3:] @ Rt
+    I = R @ M6[..., 3:, 3:] @ Rt
+    top = jnp.concatenate([m, J], axis=-1)
+    bot = jnp.concatenate([jnp.swapaxes(J, -1, -2), I], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def transform_force_6(f6, offset):
+    """Shift a 6-component force/moment vector by ``offset`` (adds r x F
+    to the moment); helpers.py:486-533 (translation branch only)."""
+    return jnp.concatenate(
+        [f6[..., :3], f6[..., 3:] + jnp.cross(offset, f6[..., :3])], axis=-1
+    )
+
+
+def weight_of_point_mass(mass, dR, g=9.81):
+    """6-DOF weight load and 6x6 weight ('hydrostatic') stiffness of a
+    point mass whose CG sits at ``dR`` from the reference point.
+
+    helpers.py:1060-1082.  Returns (W:(...,6), C:(...,6,6)).
+    """
+    mass = jnp.asarray(mass)
+    Fz = -g * mass
+    zeros = jnp.zeros_like(Fz)
+    F3 = jnp.stack([zeros, zeros, Fz], axis=-1)
+    W = translate_force_3to6(F3, dR)
+    C = jnp.zeros(mass.shape + (6, 6), dtype=W.dtype)
+    C = C.at[..., 3, 3].set(-mass * g * dR[..., 2])
+    C = C.at[..., 4, 4].set(-mass * g * dR[..., 2])
+    return W, C
+
+
+def heading_rotation(heading_deg):
+    """Rotation about global z by ``heading_deg`` degrees;
+    helpers.py:587-602 ``applyHeadingToPoint`` as a matrix."""
+    c = jnp.cos(jnp.deg2rad(heading_deg))
+    s = jnp.sin(jnp.deg2rad(heading_deg))
+    z = jnp.zeros_like(c)
+    o = jnp.ones_like(c)
+    return jnp.stack(
+        [
+            jnp.stack([c, -s, z], axis=-1),
+            jnp.stack([s, c, z], axis=-1),
+            jnp.stack([z, z, o], axis=-1),
+        ],
+        axis=-2,
+    )
+
+
+def vec_vec_trans(v):
+    """Outer product v v^T; helpers.py:412-420."""
+    return v[..., :, None] * v[..., None, :]

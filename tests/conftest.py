@@ -1,0 +1,32 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh in float64.
+
+Correctness/parity tests run on CPU with x64 enabled so golden values
+from the reference implementation (float64 numpy) can be matched to
+tight tolerances; multi-chip sharding tests use the 8 virtual devices
+(mirroring how the driver validates ``dryrun_multichip``).  TPU runs use
+float32/bfloat16 via the benchmark path instead.
+"""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# NOTE: the axon TPU plugin in this image overrides JAX_PLATFORMS at import
+# time, so the env var alone is not enough — set the config explicitly.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REFERENCE_DIR = "/root/reference"
+REF_TEST_DATA = os.path.join(REFERENCE_DIR, "tests", "test_data")
+
+
+def ref_data(*parts):
+    """Path into the reference's golden test-data directory (read-only)."""
+    return os.path.join(REF_TEST_DATA, *parts)
