@@ -1,0 +1,36 @@
+"""Top-level Model: frequency grid, FOWTs, load-case analysis.
+
+The array-level equivalent of the reference Model
+(``/root/reference/raft/raft_model.py:27-2245``).  Round-1 scope:
+single-FOWT construction, statics, Morison hydro and the dynamics
+solve; arrays/farms and potential flow wired in later milestones.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.models.fowt import FOWTStructure
+from raft_tpu.structure.schema import coerce, frequency_grid, load_design, parse_cases
+from raft_tpu.ops.waves import wave_number
+
+
+class Model:
+    def __init__(self, design):
+        design = load_design(design)
+        self.design = design
+
+        settings = design.get("settings", {}) or {}
+        self.XiStart = coerce(settings, "XiStart", default=0.1)
+        self.nIter = int(coerce(settings, "nIter", default=15, dtype=int))
+
+        self.w = frequency_grid(design)
+        self.nw = len(self.w)
+        self.depth = float(coerce(design["site"], "water_depth"))
+        self.k = np.asarray(wave_number(self.w, self.depth))
+
+        self.cases = parse_cases(design)
+
+        # single-FOWT mode (array mode in a later milestone)
+        self.fowtList = [FOWTStructure(design, depth=self.depth)]
+        self.nDOF = sum(f.nDOF for f in self.fowtList)

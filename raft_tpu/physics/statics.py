@@ -1,0 +1,466 @@
+"""Statics: mass/inertia, weight, and hydrostatics of a FOWT (jax).
+
+Pure-function twin of the reference statics stage
+(``/root/reference/raft/raft_fowt.py`` ``calcStatics`` :811-1285,
+``/root/reference/raft/raft_member.py`` ``getInertia`` :380-836,
+``getHydrostatics`` :838-1156, ``getWeight`` :1158-1259), re-designed
+for tracing:
+
+* member *geometry* integrals (section masses, local MoI) were already
+  reduced to per-element constants at build time
+  (:mod:`raft_tpu.structure.members`);
+* everything pose-dependent here is ``jax.numpy`` on those constants,
+  with the waterplane-crossing branches expressed as ``where`` masks,
+  so ``calc_statics`` jits and vmaps over mean-offset and design axes;
+* DOF reduction is applied node-block-wise with the rigid-body
+  transformation ``T_n = [[I, H(r_n - r_root)], [0, I]]`` (equivalent
+  to the reference's assembled-T congruence, raft_fowt.py:1118-1128)
+  and the geometric-stiffness correction from the T-derivative
+  (raft_fowt.py:1182-1194) in closed form.
+
+Supported round-1 scope: rigid members (single structural node each);
+flexible beams to follow.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ops import transforms as tf
+from raft_tpu.ops import frustum as fr
+
+
+# ---------------------------------------------------------------- kinematics
+
+def platform_kinematics(fs, Xi0):
+    """Displaced node positions and platform rotation for a single-rigid-body
+    FOWT (nonlinear rigid kinematics; raft_fowt.py:669-752).
+
+    Returns (r_nodes (N,3), R_ptfm (3,3), r_root (3,)).
+    """
+    Xi0 = jnp.asarray(Xi0)
+    R = tf.rotation_matrix(Xi0[3], Xi0[4], Xi0[5])
+    r0 = jnp.asarray(fs.node_r0)
+    r_root0 = r0[fs.root_id]
+    d = r0 - r_root0
+    r_nodes = r0 + Xi0[:3] + (d @ R.T - d)  # (R - I) @ d, batched
+    return r_nodes, R, r_nodes[fs.root_id]
+
+
+def node_T(r_nodes, r_root):
+    """Per-node reduction matrix [[I, H(d)],[0, I]], d = r_n - r_root.
+
+    Matches the assembled T of topology.reduce for a single rigid body
+    (chained H blocks are additive)."""
+    d = r_nodes - r_root
+    H = tf.skew(d)
+    N = d.shape[0]
+    I3 = jnp.broadcast_to(jnp.eye(3, dtype=H.dtype), (N, 3, 3))
+    Z3 = jnp.zeros_like(I3)
+    top = jnp.concatenate([I3, H], axis=-1)
+    bot = jnp.concatenate([Z3, I3], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+# ------------------------------------------------------------ member pieces
+
+def member_inertia(mem, R_mem, q):
+    """Member mass matrix (6x6 about its node), weight & weight-stiffness.
+
+    Uses the precomputed inertia elements (mass, axial CG offset s,
+    local principal MoI); raft_member.py:412-541 + getWeight :1179-1181.
+
+    Returns (M6, W6, C6, mass, s_bar) with s_bar the axial CG offset.
+    """
+    m_e = jnp.asarray(mem.elem_mass)
+    s_e = jnp.asarray(mem.elem_s)
+    I_loc = jnp.zeros((len(mem.elem_mass), 3, 3))
+    I_loc = I_loc.at[:, 0, 0].set(jnp.asarray(mem.elem_Ixx))
+    I_loc = I_loc.at[:, 1, 1].set(jnp.asarray(mem.elem_Iyy))
+    I_loc = I_loc.at[:, 2, 2].set(jnp.asarray(mem.elem_Izz))
+    I_rot = R_mem @ I_loc @ R_mem.T  # (ne,3,3)
+
+    M6_e = jnp.zeros((len(mem.elem_mass), 6, 6))
+    M6_e = M6_e.at[:, 0, 0].set(m_e)
+    M6_e = M6_e.at[:, 1, 1].set(m_e)
+    M6_e = M6_e.at[:, 2, 2].set(m_e)
+    M6_e = M6_e.at[:, 3:, 3:].set(I_rot)
+
+    r_e = q[None, :] * s_e[:, None]  # element CG relative to member node
+    M6 = jnp.sum(tf.translate_matrix_6to6(M6_e, r_e), axis=0)
+
+    mass = jnp.sum(m_e)
+    s_bar = jnp.where(mass > 0, jnp.sum(m_e * s_e) / jnp.where(mass > 0, mass, 1.0), 0.0)
+    return M6, mass, s_bar, M6_e  # W/C computed by caller with g
+
+
+def member_hydrostatics(mem, q, p1, p2, R_mem, r_node, rho, g):
+    """Buoyancy force/stiffness of one rigid member about its node.
+
+    raft_member.py:838-1156 (rigid branch), vectorised over sections
+    with crossing/submerged where-masks.
+
+    Returns dict with Fvec(6), Cmat(6,6), V_UW, r_centerV(3 — global
+    center*V sum), AWP, IWP, xWP, yWP (last-crossing values, member
+    convention), where positions are global.
+    """
+    st = jnp.asarray(mem.stations)
+    n = len(mem.stations)
+    circ = mem.circular
+
+    beta = jnp.arctan2(q[1], q[0])
+    phi = jnp.arctan2(jnp.sqrt(q[0] ** 2 + q[1] ** 2), q[2])
+    cosPhi, sinPhi, tanPhi = jnp.cos(phi), jnp.sin(phi), jnp.tan(phi)
+    cosBeta, sinBeta = jnp.cos(beta), jnp.sin(beta)
+
+    Fvec = jnp.zeros(6)
+    Cmat = jnp.zeros((6, 6))
+    V_UW = jnp.asarray(0.0)
+    r_centerV = jnp.zeros(3)
+    AWP = jnp.asarray(0.0)
+    IWP = jnp.asarray(0.0)
+    xWPr = jnp.asarray(0.0)
+    yWPr = jnp.asarray(0.0)
+
+    for i in range(1, n):
+        rA = r_node + q * st[i - 1]
+        rB = r_node + q * st[i]
+        crossing = rA[2] * rB[2] <= 0
+        submerged = (~crossing) & (rA[2] <= 0) & (rB[2] <= 0)
+
+        dz = rB[2] - rA[2]
+        dz_safe = jnp.where(dz == 0, 1.0, dz)
+        frac0 = (0.0 - rA[2]) / dz_safe  # waterplane crossing fraction
+
+        # geometry at the waterplane — NOTE the reference interpolates
+        # with the diameter endpoints swapped (raft_member.py:902,908);
+        # reproduced verbatim for parity.
+        if circ:
+            dA_o, dB_o = mem.d[i - 1, 0], mem.d[i, 0]
+            dWP = dB_o + frac0 * (dA_o - dB_o)
+            AWP_i = 0.25 * jnp.pi * dWP**2
+            IWP_i = (jnp.pi / 64.0) * dWP**4
+            IxWP_i = IWP_i
+            IyWP_i = IWP_i
+        else:
+            slA_o = jnp.asarray(mem.d[i - 1])
+            slB_o = jnp.asarray(mem.d[i])
+            slWP = slB_o + frac0 * (slA_o - slB_o)
+            AWP_i = slWP[0] * slWP[1]
+            Ix_loc = (1.0 / 12.0) * slWP[0] * slWP[1] ** 3
+            Iy_loc = (1.0 / 12.0) * slWP[0] ** 3 * slWP[1]
+            I_loc = jnp.diag(jnp.stack([Ix_loc, Iy_loc, jnp.asarray(0.0)]))
+            I_rot = R_mem @ I_loc @ R_mem.T
+            IxWP_i = I_rot[0, 0]
+            IyWP_i = I_rot[1, 1]
+            IWP_i = Ix_loc  # reference returns the scalar IWP only for circ;
+            # for rect it returns the pre-loop IWP (stays 0/prev) — see below
+
+        cosPhi_safe = jnp.where(cosPhi == 0, 1.0, cosPhi)
+        LWP = jnp.abs(rA[2] / cosPhi_safe)
+
+        # ---- crossing branch (partially submerged) raft_member.py:895-977
+        if circ:
+            V_c, hc_c = fr.frustum_vcv_circ(mem.d[i - 1, 0], dWP, LWP)
+        else:
+            V_c, hc_c = fr.frustum_vcv_rect(jnp.asarray(mem.d[i - 1]), slWP, LWP)
+        r_center_c = rA + q * hc_c
+
+        Fz_c = rho * g * V_c
+        if circ:
+            M_c = -rho * g * jnp.pi * (
+                dWP**2 / 32.0 * (2.0 + tanPhi**2) + 0.5 * (rA[2] / cosPhi_safe) ** 2
+            ) * sinPhi
+        else:
+            M_c = jnp.asarray(0.0)
+
+        F_c = tf.translate_force_3to6(jnp.stack([0.0 * Fz_c, 0.0 * Fz_c, Fz_c]), rA - r_node)
+        F_c = F_c.at[3].add(M_c * (-sinBeta))
+        F_c = F_c.at[4].add(M_c * cosBeta)
+
+        xWP_c = rA[0] + frac0 * (rB[0] - rA[0]) - r_node[0]
+        yWP_c = rA[1] + frac0 * (rB[1] - rA[1]) - r_node[1]
+        r_rel_c = r_center_c - r_node
+        C_c = jnp.zeros((6, 6))
+        C_c = C_c.at[2, 2].add(rho * g * AWP_i / cosPhi_safe)
+        C_c = C_c.at[2, 3].add(rho * g * (-AWP_i * yWP_c))
+        C_c = C_c.at[2, 4].add(rho * g * (AWP_i * xWP_c))
+        C_c = C_c.at[3, 2].add(rho * g * (-AWP_i * yWP_c))
+        C_c = C_c.at[3, 3].add(rho * g * (IxWP_i + AWP_i * yWP_c**2))
+        C_c = C_c.at[3, 4].add(rho * g * (AWP_i * xWP_c * yWP_c))
+        C_c = C_c.at[4, 2].add(rho * g * (AWP_i * xWP_c))
+        C_c = C_c.at[4, 3].add(rho * g * (AWP_i * xWP_c * yWP_c))
+        C_c = C_c.at[4, 4].add(rho * g * (IyWP_i + AWP_i * xWP_c**2))
+        C_c = C_c.at[3, 3].add(rho * g * V_c * r_rel_c[2])
+        C_c = C_c.at[4, 4].add(rho * g * V_c * r_rel_c[2])
+        C_c = C_c.at[3, 5].add(-rho * g * V_c * r_rel_c[0])
+        C_c = C_c.at[4, 5].add(-rho * g * V_c * r_rel_c[1])
+
+        # ---- fully submerged branch raft_member.py:979-1001
+        if circ:
+            V_s, hc_s = fr.frustum_vcv_circ(mem.d[i - 1, 0], mem.d[i, 0], st[i] - st[i - 1])
+        else:
+            V_s, hc_s = fr.frustum_vcv_rect(
+                jnp.asarray(mem.d[i - 1]), jnp.asarray(mem.d[i]), st[i] - st[i - 1]
+            )
+        r_center_s = rA + q * hc_s
+        r_rel_s = r_center_s - r_node
+        F_s = tf.translate_force_3to6(
+            jnp.stack([0.0 * V_s, 0.0 * V_s, rho * g * V_s]), r_rel_s
+        )
+        C_s = jnp.zeros((6, 6))
+        C_s = C_s.at[3, 3].add(rho * g * V_s * r_rel_s[2])
+        C_s = C_s.at[4, 4].add(rho * g * V_s * r_rel_s[2])
+        C_s = C_s.at[3, 5].add(-rho * g * V_s * r_rel_s[0])
+        C_s = C_s.at[4, 5].add(-rho * g * V_s * r_rel_s[1])
+
+        # ---- select by mask and accumulate
+        c = crossing
+        s = submerged
+        Fvec = Fvec + jnp.where(c, F_c, 0.0) + jnp.where(s, F_s, 0.0)
+        Cmat = Cmat + jnp.where(c, C_c, 0.0) + jnp.where(s, C_s, 0.0)
+        V_i = jnp.where(c, V_c, jnp.where(s, V_s, 0.0))
+        r_center_i = jnp.where(c, r_center_c, jnp.where(s, r_center_s, 0.0))
+        V_UW = V_UW + V_i
+        r_centerV = r_centerV + r_center_i * V_i
+        # member-level waterplane values keep the LAST crossing section
+        AWP = jnp.where(c, AWP_i, AWP)
+        if circ:
+            IWP = jnp.where(c, IWP_i, IWP)
+        xWPr = jnp.where(c, xWP_c + r_node[0], xWPr)  # global (pre -rRP value)
+        yWPr = jnp.where(c, yWP_c + r_node[1], yWPr)
+
+    return dict(
+        Fvec=Fvec, Cmat=Cmat, V_UW=V_UW, r_centerV=r_centerV,
+        AWP=AWP, IWP=IWP, xWP=xWPr, yWP=yWPr,
+    )
+
+
+# ------------------------------------------------------------ FOWT assembly
+
+def calc_statics(fs, Xi0=None):
+    """Full FOWT statics about the root node in reduced DOFs.
+
+    Equivalent of FOWT.calcStatics (raft_fowt.py:811-1285) for rigid
+    FOWTs.  Returns a dict of reduced matrices and summary properties.
+    """
+    rho, g = fs.rho_water, fs.g
+    nDOF = fs.nDOF
+    if Xi0 is None:
+        Xi0 = jnp.zeros(nDOF)
+    if not fs.is_single_body:
+        raise NotImplementedError("multibody statics pending (round-1 scope)")
+
+    r_nodes, R_ptfm, r_root = platform_kinematics(fs, Xi0)
+    Tn = node_T(r_nodes, r_root)  # (N, 6, 6)
+
+    # per-node 6x6 blocks / 6-vectors in full DOFs
+    N = fs.n_nodes
+    M_blocks = jnp.zeros((N, 6, 6))
+    Msub_blocks = jnp.zeros((N, 6, 6))
+    Cs_blocks = jnp.zeros((N, 6, 6))
+    Cssub_blocks = jnp.zeros((N, 6, 6))
+    Ch_blocks = jnp.zeros((N, 6, 6))
+    W_blocks = jnp.zeros((N, 6))
+    Wsub_blocks = jnp.zeros((N, 6))
+    Wh_blocks = jnp.zeros((N, 6))
+    f0_blocks = jnp.zeros((N, 6))
+
+    m_center_sum = jnp.zeros(3)
+    m_sub_sum = jnp.zeros(3)
+    m_sub = jnp.asarray(0.0)
+    VTOT = jnp.asarray(0.0)
+    AWP_TOT = jnp.asarray(0.0)
+    IWPx_TOT = jnp.asarray(0.0)
+    IWPy_TOT = jnp.asarray(0.0)
+    Sum_V_rCB = jnp.zeros(3)
+    mtower = []
+    rCG_tow = []
+
+    # ---------------- members (inertia loop excludes nacelles,
+    # raft_fowt.py:876-935; hydrostatics of members named 'nacelle'
+    # added separately :1007-1030)
+    for im, mem in enumerate(fs.members):
+        node = int(fs.member_node[im])
+        r_node = r_nodes[node]
+        R_mem = R_ptfm @ jnp.asarray(mem.R0)
+        q = R_ptfm @ jnp.asarray(mem.q0)
+        p1 = R_ptfm @ jnp.asarray(mem.p10)
+        p2 = R_ptfm @ jnp.asarray(mem.p20)
+
+        if mem.part_of != "nacelle":
+            M6, mass, s_bar, _ = member_inertia(mem, R_mem, q)
+            W6, C6 = tf.weight_of_point_mass(mass, q * s_bar, g=g)
+            M_blocks = M_blocks.at[node].add(M6)
+            W_blocks = W_blocks.at[node].add(W6)
+            Cs_blocks = Cs_blocks.at[node].add(C6)
+            center = q * s_bar + jnp.asarray(fs.node_r0[node])  # ref: uses r0 (raft_fowt.py:900)
+            m_center_sum = m_center_sum + center * mass
+            if mem.part_of == "tower":
+                mtower.append(mass)
+                rCG_tow.append(center)
+            else:
+                Msub_blocks = Msub_blocks.at[node].add(M6)
+                Cssub_blocks = Cssub_blocks.at[node].add(C6)
+                Wsub_blocks = Wsub_blocks.at[node].add(W6)
+                m_sub = m_sub + mass
+                m_sub_sum = m_sub_sum + center * mass
+
+            hs = member_hydrostatics(mem, q, p1, p2, R_mem, r_node, rho, g)
+        elif mem.name == "nacelle":
+            hs = member_hydrostatics(mem, q, p1, p2, R_mem, r_node, rho, g)
+        else:
+            continue
+
+        Wh_blocks = Wh_blocks.at[node].add(hs["Fvec"])
+        Ch_blocks = Ch_blocks.at[node].add(hs["Cmat"])
+        # totals about the PRP (raft_fowt.py:926-935) — xWP/yWP made
+        # global by adding the member's undisplaced node position
+        xWP = hs["xWP"] - r_node[0] + jnp.asarray(fs.node_r0[node][0])
+        yWP = hs["yWP"] - r_node[1] + jnp.asarray(fs.node_r0[node][1])
+        VTOT = VTOT + hs["V_UW"]
+        AWP_TOT = AWP_TOT + hs["AWP"]
+        IWPx_TOT = IWPx_TOT + hs["IWP"] + hs["AWP"] * yWP**2
+        IWPy_TOT = IWPy_TOT + hs["IWP"] + hs["AWP"] * xWP**2
+        V = hs["V_UW"]
+        rCB_m = jnp.where(
+            V > 0, hs["r_centerV"] / jnp.where(V > 0, V, 1.0) - r_node, jnp.zeros(3)
+        )
+        Sum_V_rCB = Sum_V_rCB + (rCB_m + jnp.asarray(fs.node_r0[node])) * V
+
+    # ---------------- RNA inertia (raft_fowt.py:1033-1052)
+    for ir, rot in enumerate(fs.rotors):
+        node = int(fs.rotor_node[ir])
+        q_rot = R_ptfm @ jnp.asarray(rot.q_rel)
+        R_q = jnp.asarray(rot.R_q0) @ R_ptfm  # reference order, raft_rotor.py:467
+        Mmat = jnp.diag(jnp.asarray([rot.mRNA, rot.mRNA, rot.mRNA,
+                                     rot.IxRNA, rot.IrRNA, rot.IrRNA]))
+        Mmat = tf.rotate_matrix_6(Mmat, R_q)
+        dCG = q_rot * rot.xCG_RNA  # r_CG_rel - r_RRP_rel
+        W6, C6 = tf.weight_of_point_mass(rot.mRNA, dCG, g=g)
+        W_blocks = W_blocks.at[node].add(W6)
+        M_blocks = M_blocks.at[node].add(tf.translate_matrix_6to6(Mmat, dCG))
+        Cs_blocks = Cs_blocks.at[node].add(C6)
+        r_CG_rel = R_ptfm @ jnp.asarray(rot.r_rel) + dCG
+        m_center_sum = m_center_sum + r_CG_rel * rot.mRNA
+
+    # ---------------- point inertias (raft_fowt.py:1054-1072)
+    for pi in fs.pointInertias:
+        node = int(
+            np.argmin(np.linalg.norm(fs.node_r0 - np.asarray(pi["r"]), axis=1))
+        )
+        dR = jnp.asarray(pi["r"] - fs.node_r0[node])
+        W6, C6 = tf.weight_of_point_mass(pi["m"], dR, g=g)
+        M6 = tf.translate_matrix_6to6(jnp.asarray(pi["inertia"]), dR)
+        W_blocks = W_blocks.at[node].add(W6)
+        M_blocks = M_blocks.at[node].add(M6)
+        Cs_blocks = Cs_blocks.at[node].add(C6)
+        Msub_blocks = Msub_blocks.at[node].add(M6)
+        Cssub_blocks = Cssub_blocks.at[node].add(C6)
+        Wsub_blocks = Wsub_blocks.at[node].add(W6)
+        m_sub = m_sub + pi["m"]
+        m_sub_sum = m_sub_sum + jnp.asarray(pi["r"]) * pi["m"]
+        m_center_sum = m_center_sum + jnp.asarray(pi["r"]) * pi["m"]
+
+    # ---------------- user point loads (raft_fowt.py:1074-1080)
+    for pl in fs.pointLoads:
+        node = int(
+            np.argmin(np.linalg.norm(fs.node_r0 - np.asarray(pl["r"]), axis=1))
+        )
+        f6 = tf.transform_force_6(jnp.asarray(pl["f"]),
+                                  jnp.asarray(pl["r"] - fs.node_r0[node]))
+        f0_blocks = f0_blocks.at[node].add(f6)
+
+    # ---------------- reduce to the structure DOFs (raft_fowt.py:1118-1128)
+    def reduce_mat(blocks):
+        return jnp.einsum("nia,nij,njb->ab", Tn, blocks, Tn)
+
+    def reduce_vec(blocks):
+        return jnp.einsum("nia,ni->a", Tn, blocks)
+
+    M_struc = reduce_mat(M_blocks)
+    M_struc_sub = reduce_mat(Msub_blocks)
+    C_struc = reduce_mat(Cs_blocks)
+    C_struc_sub = reduce_mat(Cssub_blocks)
+    C_hydro = reduce_mat(Ch_blocks)
+    W_struc = reduce_vec(W_blocks)
+    W_hydro = reduce_vec(Wh_blocks)
+    f0_additional = reduce_vec(f0_blocks)
+
+    # ---------------- geometric stiffness from dT (raft_fowt.py:1182-1194)
+    # C_geom[3+i, 3+j] = -sum_n cross(cross(e_j, d_n), F_n)[i]
+    d_n = r_nodes - r_root
+    eye3 = jnp.eye(3)
+
+    def c_geom(F_blocks):
+        F = F_blocks[:, :3]
+        cj = jnp.cross(eye3[None, :, :], d_n[:, None, :])     # (N, 3j, 3)
+        contrib = jnp.cross(cj, F[:, None, :])                 # (N, 3j, 3i)
+        block = -jnp.sum(contrib, axis=0).T                    # (3i, 3j)
+        C = jnp.zeros((6, 6))
+        return C.at[3:, 3:].set(block)
+
+    C_hydro = C_hydro + c_geom(Wh_blocks)
+    C_struc = C_struc + c_geom(W_blocks)
+    C_struc_sub = C_struc_sub + c_geom(Wsub_blocks)
+
+    # symmetrise (raft_fowt.py:1197-1204)
+    sym = lambda A: 0.5 * (A + A.T)
+    M_struc, M_struc_sub = sym(M_struc), sym(M_struc_sub)
+    C_hydro, C_struc, C_struc_sub = sym(C_hydro), sym(C_struc), sym(C_struc_sub)
+
+    # ---------------- totals (raft_fowt.py:1206-1285)
+    m_all = M_struc[0, 0]
+    rCG = m_center_sum / m_all
+    rCG_sub = m_sub_sum / jnp.where(m_sub > 0, m_sub, 1.0)
+    M_sub6 = tf.translate_matrix_6to6(M_struc_sub[:6, :6], -rCG_sub)
+    M_all6 = tf.translate_matrix_6to6(M_struc[:6, :6], -rCG)
+
+    rCB = Sum_V_rCB / jnp.where(VTOT > 0, VTOT, 1.0)
+    zMeta = jnp.where(VTOT > 0, rCB[2] + IWPx_TOT / jnp.where(VTOT > 0, VTOT, 1.0), 0.0)
+
+    # ballast bookkeeping (static; raft_fowt.py:1231-1242)
+    pb = []
+    for mem in fs.members:
+        if mem.part_of == "nacelle":
+            continue
+        for p in mem.pfill:
+            if p != 0 and p not in pb:
+                pb.append(p)
+    m_ballast = np.zeros(len(pb))
+    for mem in fs.members:
+        if mem.part_of == "nacelle":
+            continue
+        for mf, p in zip(mem.mfill, mem.pfill):
+            if p != 0:
+                m_ballast[pb.index(p)] += mf
+
+    return dict(
+        M_struc=M_struc,
+        M_struc_sub=M_struc_sub,
+        C_struc=C_struc,
+        C_struc_sub=C_struc_sub,
+        C_hydro=C_hydro,
+        C_elast=jnp.zeros((nDOF, nDOF)),
+        W_struc=W_struc,
+        W_hydro=W_hydro,
+        f0_additional=f0_additional,
+        rCG=rCG,
+        rCG_sub=rCG_sub,
+        rCB=rCB,
+        m=m_all,
+        m_sub=m_sub,
+        V=VTOT,
+        AWP=AWP_TOT,
+        rM=jnp.stack([rCB[0], rCB[1], zMeta]),
+        m_ballast=jnp.asarray(m_ballast),
+        pb=pb,
+        mtower=mtower,
+        rCG_tow=rCG_tow,
+        M_all6=M_all6,
+        M_sub6=M_sub6,
+        r_nodes=r_nodes,
+        R_ptfm=R_ptfm,
+        Tn=Tn,
+    )

@@ -1,0 +1,88 @@
+"""Design-dictionary schema utilities (build time, numpy).
+
+A tolerant reader for the RAFT-compatible YAML design schema
+(documented in the reference at ``docs/usage.rst:100-520``).  The
+framework keeps full input-file compatibility with the reference so
+existing designs run unmodified; ``coerce`` mirrors the semantics of
+the reference's ``getFromDict`` (``/root/reference/raft/helpers.py:828``):
+scalars broadcast to requested shapes, lists are length-checked, and
+missing keys either raise or take defaults.
+
+This layer runs once per design at build time and produces plain numpy;
+nothing here is traced.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import yaml
+
+
+def coerce(d, key, shape=0, dtype=float, default=None, index=None):
+    """Fetch ``d[key]`` coerced to ``dtype`` and ``shape``.
+
+    shape semantics (matching helpers.py:828-906):
+      0   scalar expected;
+      -1  any shape accepted (scalar stays scalar);
+      n   1-D array of length n (scalars tile; ``index`` selects a column
+          of 2-D input / tiles an element of 1-D input);
+      [m, n]  2-D array (1-D rows tile m times).
+    """
+    if key in d:
+        val = d[key]
+        if shape == 0:
+            if np.isscalar(val):
+                return dtype(val)
+            raise ValueError(f"'{key}' expected scalar, got {val!r}")
+        if shape == -1:
+            return dtype(val) if np.isscalar(val) else np.array(val, dtype=dtype)
+        if np.isscalar(val):
+            return np.tile(dtype(val), shape)
+        if np.isscalar(shape):
+            if len(val) != shape:
+                raise ValueError(f"'{key}' expected length {shape}, got {val!r}")
+            if index is None:
+                return np.array([dtype(v) for v in val])
+            arr = np.array(val)
+            if arr.ndim == 1:
+                return np.tile(arr[index], shape)
+            return np.array([v[index] for v in val])
+        arr = np.array(val, dtype=dtype)
+        if list(arr.shape) == list(shape):
+            return arr
+        if arr.ndim == 1 and len(arr) == shape[1]:
+            return np.tile(arr, [shape[0], 1])
+        raise ValueError(f"'{key}' incompatible with shape {shape}: {val!r}")
+    if default is None:
+        raise ValueError(f"Key '{key}' not found in design input")
+    if shape in (0, -1):
+        return default
+    if np.isscalar(default):
+        return np.tile(default, shape)
+    return np.tile(default, [shape, 1])
+
+
+def load_design(path_or_dict):
+    """Load a design from a YAML path or pass a dict through."""
+    if isinstance(path_or_dict, dict):
+        return path_or_dict
+    with open(path_or_dict) as f:
+        return yaml.load(f, Loader=yaml.FullLoader)
+
+
+def parse_cases(design):
+    """The load-case table as a list of dicts (docs/usage.rst:167)."""
+    if "cases" not in design:
+        return []
+    keys = design["cases"]["keys"]
+    return [dict(zip(keys, row)) for row in design["cases"]["data"]]
+
+
+def frequency_grid(design):
+    """Angular frequency grid from the settings section
+    (raft_model.py:46-58): min_freq doubles as the bin width."""
+    settings = design.get("settings", {}) or {}
+    min_freq = coerce(settings, "min_freq", default=0.01)
+    max_freq = coerce(settings, "max_freq", default=1.00)
+    w = np.arange(min_freq, max_freq + 0.5 * min_freq, min_freq) * 2 * np.pi
+    return w
