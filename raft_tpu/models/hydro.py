@@ -1,0 +1,110 @@
+"""Case-level hydrodynamics driver for one FOWT.
+
+Glue between the load-case table and the Morison kernels: builds the
+sea-state arrays for a case (spectra -> component amplitudes,
+``raft_fowt.py:1737-1774``) and exposes the per-stage entry points the
+Model dynamics solver (and the parity tests) use.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu.ops import waves as wv
+from raft_tpu.physics import morison
+from raft_tpu.physics.statics import platform_kinematics, node_T
+from raft_tpu.structure.schema import coerce
+
+
+def make_sea_state(case, w):
+    """(S, zeta, beta[rad]) arrays of shape (nWaves, nw) / (nWaves,).
+
+    raft_fowt.py:1742-1774; zeta = sqrt(2 S dw)."""
+    w = np.asarray(w)
+    dw = w[1] - w[0]
+    if np.isscalar(case["wave_heading"]):
+        nWaves = 1
+    else:
+        nWaves = len(case["wave_heading"])
+    heading = coerce(case, "wave_heading", shape=nWaves, default=0)
+    spectrum = coerce(case, "wave_spectrum", shape=nWaves, dtype=str, default="JONSWAP")
+    period = coerce(case, "wave_period", shape=nWaves)
+    height = coerce(case, "wave_height", shape=nWaves)
+    gamma = coerce(case, "wave_gamma", shape=nWaves, default=0)
+
+    S = np.zeros((nWaves, len(w)))
+    zeta = np.zeros((nWaves, len(w)))
+    for ih in range(nWaves):
+        if spectrum[ih] == "unit":
+            S[ih] = 1.0
+            zeta[ih] = np.sqrt(2 * S[ih] * dw)
+        elif spectrum[ih] == "constant":
+            S[ih] = height[ih]
+            zeta[ih] = np.sqrt(2 * S[ih] * dw)
+        elif spectrum[ih] == "JONSWAP":
+            S[ih] = np.asarray(wv.jonswap(w, height[ih], period[ih], gamma=gamma[ih]))
+            zeta[ih] = np.sqrt(2 * S[ih] * dw)
+        elif spectrum[ih] in ("none", "still"):
+            pass
+        else:
+            raise ValueError(f"unknown wave spectrum {spectrum[ih]!r}")
+    beta = np.deg2rad(heading)
+    return S, zeta, beta
+
+
+class FOWTHydro:
+    """Per-FOWT hydro state: strips + pose-dependent tensors."""
+
+    def __init__(self, fs, w, k):
+        self.fs = fs
+        self.w = np.asarray(w)
+        self.k = np.asarray(k)
+        self.nw = len(self.w)
+        self.strips = morison.build_strips(fs, k_array=self.k)
+        self.set_position(np.zeros(fs.nDOF))
+
+    def set_position(self, Xi0):
+        self.Xi0 = jnp.asarray(Xi0)
+        self.r_nodes, self.R_ptfm, self.r_root = platform_kinematics(self.fs, self.Xi0)
+        self.Tn = node_T(self.r_nodes, self.r_root)
+        self.hc = morison.hydro_constants(
+            self.fs, self.strips, self.R_ptfm, self.r_nodes, self.Tn
+        )
+
+    @property
+    def A_hydro_morison(self):
+        return self.hc["A_hydro"]
+
+    def hydro_excitation(self, case):
+        S, zeta, beta = make_sea_state(case, self.w)
+        self.S, self.zeta, self.beta = S, zeta, beta
+        out = morison.hydro_excitation(
+            self.fs, self.strips, self.hc,
+            jnp.asarray(zeta, dtype=complex), jnp.asarray(beta),
+            jnp.asarray(self.w), jnp.asarray(self.k), self.Tn, self.r_nodes,
+        )
+        self.u = out["u"]
+        return out
+
+    def hydro_linearization(self, Xi, ih=0):
+        return morison.hydro_linearization(
+            self.fs, self.strips, self.hc, self.u[ih], jnp.asarray(Xi),
+            jnp.asarray(self.w), self.Tn, self.r_nodes,
+        )
+
+    def drag_excitation(self, Bmat, ih):
+        return morison.drag_excitation(
+            self.fs, self.strips, self.hc, Bmat, self.u[ih], self.Tn, self.r_nodes
+        )
+
+    def current_loads(self, case):
+        speed = coerce(case, "current_speed", shape=0, default=0.0)
+        heading = coerce(case, "current_heading", shape=0, default=0)
+        Zref = 0.0
+        for rot in self.fs.rotors:
+            if rot.Zhub < 0:
+                Zref = rot.Zhub
+        return morison.current_loads(
+            self.fs, self.strips, self.hc, speed, heading, Zref, self.Tn, self.r_nodes
+        )

@@ -12,7 +12,7 @@ import numpy as np
 
 from raft_tpu.models.fowt import FOWTStructure
 from raft_tpu.structure.schema import coerce, frequency_grid, load_design, parse_cases
-from raft_tpu.ops.waves import wave_number
+from raft_tpu.ops.waves import wave_number_ref
 
 
 class Model:
@@ -27,7 +27,9 @@ class Model:
         self.w = frequency_grid(design)
         self.nw = len(self.w)
         self.depth = float(coerce(design["site"], "water_depth"))
-        self.k = np.asarray(wave_number(self.w, self.depth))
+        # reference-compatible dispersion solve (loose 1e-3 iteration,
+        # raft_model.py:63-65) so downstream values match golden data
+        self.k = wave_number_ref(self.w, self.depth)
 
         self.cases = parse_cases(design)
 

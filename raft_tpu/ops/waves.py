@@ -53,6 +53,28 @@ def wave_number(w, h, g=9.81, n_iter=12):
     return k
 
 
+def wave_number_ref(w, h, g=9.81, e=0.001):
+    """Build-time (numpy) twin of the reference's dispersion iteration,
+    including its loose 1e-3 stopping rule (helpers.py:377-392) — used
+    for the model frequency grid so golden values match bit-for-bit.
+    The traced kernels use :func:`wave_number` (full precision)."""
+    import numpy as np
+
+    w = np.atleast_1d(np.asarray(w, dtype=float))
+    k = np.zeros_like(w)
+    for i, wi in enumerate(w):
+        k1 = wi * wi / g
+        if k1 == 0.0:
+            k[i] = 0.0
+            continue
+        k2 = wi * wi / (np.tanh(k1 * h) * g)
+        while abs(k2 - k1) / k1 > e:
+            k1 = k2
+            k2 = wi * wi / (np.tanh(k1 * h) * g)
+        k[i] = k2
+    return k
+
+
 def jonswap(ws, Hs, Tp, gamma=None):
     """One-sided JONSWAP spectrum S(w) [m^2/(rad/s)]; helpers.py:703-760.
 

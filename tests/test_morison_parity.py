@@ -1,0 +1,98 @@
+"""Morison hydrodynamics parity vs reference golden pickles.
+
+Mirrors /root/reference/tests/test_fowt.py: hydroConstants,
+hydroExcitation (heading x period x height sweep), hydroLinearization
+(prescribed response), and current loads, compared against the
+reference's *_true_*.pkl at its own tolerances.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+from tests.conftest import ref_data
+
+import raft_tpu
+from raft_tpu.models.hydro import FOWTHydro
+
+DESIGNS = [
+    "OC3spar.yaml",
+    "VolturnUS-S.yaml",
+    "VolturnUS-S-pointInertia.yaml",
+    "OC4semi-WAMIT_Coefs.yaml",
+]
+
+
+def make_hydro(design_name):
+    path = ref_data(design_name)
+    if not os.path.exists(path):
+        pytest.skip(f"missing reference data {path}")
+    model = raft_tpu.Model(path)
+    return path, FOWTHydro(model.fowtList[0], model.w, model.k)
+
+
+@pytest.fixture(params=DESIGNS, ids=[d.split(".")[0] for d in DESIGNS])
+def design_and_hydro(request):
+    return make_hydro(request.param)
+
+
+def test_hydro_constants(design_and_hydro):
+    path, fh = design_and_hydro
+    with open(path.replace(".yaml", "_true_hydroConstants.pkl"), "rb") as f:
+        true = pickle.load(f)
+    assert_allclose(
+        np.asarray(fh.A_hydro_morison), true["A_hydro_morison"], rtol=1e-5, atol=1e-3
+    )
+
+
+def test_hydro_excitation(design_and_hydro):
+    path, fh = design_and_hydro
+    with open(path.replace(".yaml", "_true_hydroExcitation.pkl"), "rb") as f:
+        true = pickle.load(f)
+    idx = 0
+    for wave_heading in [0, 45, 90, 135, 180, 225, 270, 315, 360]:
+        for wave_period in [5, 10, 15, 20]:
+            for wave_height in [1, 2]:
+                case = {
+                    "wave_heading": wave_heading,
+                    "wave_period": wave_period,
+                    "wave_height": wave_height,
+                }
+                out = fh.hydro_excitation(case)
+                assert_allclose(
+                    np.asarray(out["F_hydro_iner"]),
+                    true[idx]["F_hydro_iner"],
+                    rtol=1e-5, atol=1e-3,
+                    err_msg=f"case {case}",
+                )
+                idx += 1
+
+
+def test_hydro_linearization(design_and_hydro):
+    path, fh = design_and_hydro
+    with open(path.replace(".yaml", "_true_hydroLinearization.pkl"), "rb") as f:
+        true = pickle.load(f)
+    case = {"wave_spectrum": "unit", "wave_heading": 0,
+            "wave_period": 10, "wave_height": 2}
+    fh.hydro_excitation(case)
+    nDOF, nw = fh.fs.nDOF, fh.nw
+    phase = np.linspace(0, 2 * np.pi, nw * nDOF).reshape(nDOF, nw)
+    Xi = 0.1 * np.exp(1j * phase)
+    out = fh.hydro_linearization(Xi, ih=0)
+    assert_allclose(
+        np.asarray(out["B_hydro_drag"]), true["B_hydro_drag"], rtol=1e-5, atol=1e-10
+    )
+    assert_allclose(
+        np.asarray(out["F_hydro_drag"]), true["F_hydro_drag"], rtol=1e-5
+    )
+
+
+def test_current_loads(design_and_hydro):
+    path, fh = design_and_hydro
+    with open(path.replace(".yaml", "_true_calcCurrentLoads.pkl"), "rb") as f:
+        true = pickle.load(f)
+    D = fh.current_loads({"current_speed": 2.0, "current_heading": 15})
+    assert_allclose(np.asarray(D), true, rtol=1e-5, atol=1e-3)
