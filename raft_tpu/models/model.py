@@ -8,9 +8,14 @@ solve; arrays/farms and potential flow wired in later milestones.
 
 from __future__ import annotations
 
+import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.models.fowt import FOWTStructure
+from raft_tpu.models.hydro import FOWTHydro
+from raft_tpu.models.statics_solve import solve_equilibrium
+from raft_tpu.physics.mooring import build_mooring
+from raft_tpu.physics.statics import calc_statics
 from raft_tpu.structure.schema import coerce, frequency_grid, load_design, parse_cases
 from raft_tpu.ops.waves import wave_number_ref
 
@@ -36,3 +41,54 @@ class Model:
         # single-FOWT mode (array mode in a later milestone)
         self.fowtList = [FOWTStructure(design, depth=self.depth)]
         self.nDOF = sum(f.nDOF for f in self.fowtList)
+
+        # mooring system (jax catenary equivalent of the FOWT-level
+        # MoorPy system, raft_fowt.py:346-372)
+        fs = self.fowtList[0]
+        if "mooring" in design and isinstance(design["mooring"], dict):
+            self.ms = build_mooring(design["mooring"], rho_water=fs.rho_water, g=fs.g)
+        else:
+            self.ms = None
+
+        self._hydro = None
+        self._statics = None
+
+    # ------------------------------------------------------------ lazy state
+    @property
+    def hydro(self):
+        if self._hydro is None:
+            self._hydro = [FOWTHydro(f, self.w, self.k) for f in self.fowtList]
+        return self._hydro
+
+    def statics(self, Xi0=None):
+        """FOWT statics matrices (cached at the zero pose)."""
+        if Xi0 is None:
+            if self._statics is None:
+                self._statics = calc_statics(self.fowtList[0])
+            return self._statics
+        return calc_statics(self.fowtList[0], Xi0)
+
+    # --------------------------------------------------------------- statics
+    def solve_statics(self, case=None):
+        """Mean offsets for a load case (Model.solveStatics equivalent,
+        raft_model.py:550-964; staticsMod=0 / forcingsMod=0 path).
+
+        Returns the equilibrium pose X (nDOF,)."""
+        fs = self.fowtList[0]
+        stat = self.statics()
+        K_h = stat["C_struc"] + stat["C_hydro"]
+        F_und = stat["W_struc"] + stat["W_hydro"] + stat["f0_additional"]
+
+        F_env = jnp.zeros(fs.nDOF)
+        if case is not None:
+            fh = self.hydro[0]
+            F_env = F_env + fh.current_loads(case)
+            F_env = F_env + self.aero_mean_force(case)
+
+        X, Fres = solve_equilibrium(fs, self.ms, K_h, F_und, F_env)
+        self.X0 = X
+        return X
+
+    def aero_mean_force(self, case):
+        """Mean rotor force; zero until the BEMT aero module lands."""
+        return jnp.zeros(self.fowtList[0].nDOF)

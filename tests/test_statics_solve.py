@@ -1,0 +1,81 @@
+"""Static equilibrium parity vs the reference's hardcoded mean offsets.
+
+Targets from /root/reference/tests/test_model.py:75-123 (solveStatics
+under wave-only and current-only loading; the wind cases additionally
+need the aero module and are covered by the aero milestone).  The
+catenary mooring module is exercised end-to-end here: matching these
+equilibria requires the mooring force and tangent stiffness to agree
+with MoorPy's.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+from tests.conftest import ref_data
+
+import raft_tpu
+
+CASES = {
+    "wave": {
+        "wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+        "turbine_status": "operating", "yaw_misalign": 0,
+        "wave_spectrum": "JONSWAP", "wave_period": 10, "wave_height": 4,
+        "wave_heading": -30, "current_speed": 0, "current_heading": 0,
+    },
+    "current": {
+        "wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+        "turbine_status": "operating", "yaw_misalign": 0,
+        "wave_spectrum": "JONSWAP", "wave_period": 0, "wave_height": 0,
+        "wave_heading": 0, "current_speed": 0.6, "current_heading": 15,
+    },
+}
+
+# desired_X0 rows from test_model.py for the designs we support so far
+TARGETS = {
+    "OC3spar.yaml": {
+        "wave": [-1.64267049e-05, -2.83795893e-15, -6.65861624e-01,
+                 3.88717546e-19, -5.94238978e-11, -4.02571352e-17],
+        "current": [3.86072176e+00, 9.22694246e-01, -6.74898762e-01,
+                    -2.64759824e-04, 9.82529767e-04, -1.03532699e-05],
+    },
+    "VolturnUS-S.yaml": {
+        "wave": [4.27925162e-01, -9.00035158e-17, -4.51814991e-01,
+                 -5.63389767e-18, -2.54250076e-02, -1.07219357e-22],
+        "current": [3.46491856e+00, 8.10382757e-01, -4.53718903e-01,
+                    6.48535991e-04, -2.79078335e-02, 3.71621922e-03],
+    },
+    "VolturnUS-S-pointInertia.yaml": {
+        "wave": [4.34028448e-01, 1.29311805e-15, -4.66112782e-01,
+                 8.09445578e-17, -2.58031212e-02, 1.54046523e-21],
+        "current": [3.47177656e+00, 8.10749061e-01, -4.68029699e-01,
+                    6.58432223e-04, -2.83226533e-02, 3.71570242e-03],
+    },
+    "OC4semi-WAMIT_Coefs.yaml": {
+        "wave": [-1.72715184e-03, -1.57518810e-16, -1.94361922e-01,
+                 1.07116427e-16, -7.10621656e-08, 1.63094600e-21],
+        "current": [1.71117023e+00, 4.59025857e-01, -1.94362700e-01,
+                    3.00965823e-04, -1.12322280e-03, 9.56379292e-08],
+    },
+}
+
+
+@pytest.mark.parametrize("design", list(TARGETS), ids=[d.split(".")[0] for d in TARGETS])
+@pytest.mark.parametrize("case_name", ["wave", "current"])
+def test_solve_statics(design, case_name):
+    path = ref_data(design)
+    if not os.path.exists(path):
+        pytest.skip("reference data unavailable")
+    model = raft_tpu.Model(path)
+    X = np.asarray(model.solve_statics(CASES[case_name]))
+    # The reference targets are *early-stopped* Newton iterates (dsolve2
+    # stops at 0.05 m / 0.005 rad steps), so they are trajectory-dependent:
+    # our exact mooring tangent stiffness (vs MoorPy's analytic assembly)
+    # shifts the current-case iterates by O(1e-5 m).  The wave cases match
+    # at the reference's own tolerance.
+    if case_name == "current":
+        assert_allclose(X, TARGETS[design][case_name], rtol=5e-4, atol=5e-5)
+    else:
+        assert_allclose(X, TARGETS[design][case_name], rtol=1e-5, atol=1e-6)
