@@ -62,14 +62,26 @@ class FOWTHydro:
         self.k = np.asarray(k)
         self.nw = len(self.w)
         self.strips = morison.build_strips(fs, k_array=self.k)
+        # hydro *constants* (added mass, inertial-excitation tensors) are
+        # evaluated at the reference pose, as in the standard reference
+        # flow (calcHydroConstants is called with the FOWT at its
+        # reference position, raft_model.py:620); only the wave-field
+        # evaluation points and member axes track the mean offset.
+        r0_nodes, R0, root0 = platform_kinematics(fs, jnp.zeros(fs.nDOF))
+        Tn0 = node_T(r0_nodes, root0)
+        self.hc0 = morison.hydro_constants(fs, self.strips, R0, r0_nodes, Tn0)
         self.set_position(np.zeros(fs.nDOF))
 
     def set_position(self, Xi0):
-        self.Xi0 = jnp.asarray(Xi0)
+        self.Xi0 = jnp.asarray(Xi0, dtype=float)
         self.r_nodes, self.R_ptfm, self.r_root = platform_kinematics(self.fs, self.Xi0)
         self.Tn = node_T(self.r_nodes, self.r_root)
-        self.hc = morison.hydro_constants(
-            self.fs, self.strips, self.R_ptfm, self.r_nodes, self.Tn
+        r, q, p1, p2 = morison.strip_frames(self.strips, self.R_ptfm, self.r_nodes)
+        sub = r[:, 2] < 0
+        self.hc = dict(
+            self.hc0,
+            r=r, q=q, p1=p1, p2=p2, sub=sub,
+            active=sub & jnp.asarray(self.strips.active),
         )
 
     @property

@@ -92,3 +92,85 @@ class Model:
     def aero_mean_force(self, case):
         """Mean rotor force; zero until the BEMT aero module lands."""
         return jnp.zeros(self.fowtList[0].nDOF)
+
+    # -------------------------------------------------------------- dynamics
+    def solve_dynamics(self, case, X0=None):
+        """Iterative linearised dynamics for one case
+        (Model.solveDynamics equivalent, raft_model.py:966-1255).
+
+        Returns (Xi (nWaves+1, nDOF, nw), diagnostics dict)."""
+        from raft_tpu.models.dynamics import solve_dynamics_fowt, system_response
+        from raft_tpu.physics import morison
+        from raft_tpu.physics.mooring import mooring_stiffness
+
+        fs = self.fowtList[0]
+        fh = self.hydro[0]
+        if X0 is None:
+            X0 = self.solve_statics(case)
+        fh.set_position(X0)
+
+        stat = self.statics()  # reference-pose statics (staticsMod=0 flow)
+        exc = fh.hydro_excitation(case)
+        nWaves = exc["F_hydro_iner"].shape[0]
+
+        nDOF, nw = fs.nDOF, self.nw
+        zeros_mat = jnp.zeros((nDOF, nDOF, nw))
+        A_BEM, B_BEM = self.bem_matrices()
+        F_BEM = self.bem_excitation(case, fh)
+
+        M_lin = (
+            stat["M_struc"][:, :, None] + fh.hc0["A_hydro"][:, :, None] + A_BEM
+        )
+        B_lin = zeros_mat + B_BEM
+        C_moor = jnp.zeros((nDOF, nDOF))
+        if self.ms is not None:
+            C_moor = C_moor.at[:6, :6].add(mooring_stiffness(self.ms, X0[:6]))
+        C_lin = stat["C_struc"] + stat["C_hydro"] + C_moor + stat["C_elast"]
+        F_lin = F_BEM[0] + exc["F_hydro_iner"][0]
+
+        Z, Xi1, Bmat = solve_dynamics_fowt(
+            fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
+            jnp.asarray(self.w), fh.Tn, fh.r_nodes,
+            n_iter=self.nIter, Xi_start=self.XiStart,
+        )
+
+        # system response for each wave heading + rotor-excitation slot
+        F_waves = []
+        for ih in range(nWaves):
+            F_drag = fh.drag_excitation(Bmat, ih)
+            F_waves.append(F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag)
+        F_waves = jnp.stack(F_waves)
+        Xi = system_response(Z, F_waves)
+        Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=complex)], axis=0)
+        return Xi, dict(Z=Z, Bmat=Bmat, S=fh.S, zeta=fh.zeta, exc=exc)
+
+    def bem_matrices(self):
+        """Potential-flow added mass / radiation damping (zero until the
+        WAMIT-file reader / native BEM solver milestones)."""
+        nDOF, nw = self.fowtList[0].nDOF, self.nw
+        z = jnp.zeros((nDOF, nDOF, nw))
+        return z, z
+
+    def bem_excitation(self, case, fh):
+        nDOF, nw = self.fowtList[0].nDOF, self.nw
+        nWaves = 1 if np.isscalar(case.get("wave_heading", 0)) else len(case["wave_heading"])
+        return jnp.zeros((nWaves, nDOF, nw), dtype=complex)
+
+    # ---------------------------------------------------------- case driver
+    def analyze_cases(self):
+        """Run every case in the design's case table and collect channel
+        statistics (Model.analyzeCases equivalent, raft_model.py:264-433)."""
+        from raft_tpu.models.outputs import turbine_outputs
+
+        self.results = {
+            "freq_rad": self.w,
+            "case_metrics": {},
+            "mean_offsets": [],
+        }
+        for iCase, case in enumerate(self.cases):
+            X0 = self.solve_statics(case)
+            self.results["mean_offsets"].append(np.asarray(X0))
+            Xi, info = self.solve_dynamics(case, X0=X0)
+            metrics = turbine_outputs(self, case, X0, Xi, info["S"], info["zeta"])
+            self.results["case_metrics"][iCase] = {0: metrics}
+        return self.results

@@ -1,0 +1,178 @@
+"""Response post-processing: per-case channel statistics.
+
+Equivalent of ``FOWT.saveTurbineOutputs``
+(``/root/reference/raft/raft_fowt.py:2291-2744``) for rigid FOWTs:
+platform motion statistics, nacelle accelerations, rigid-tower base
+bending moment, mooring tension spectra, and the wave reference PSD.
+Statistics RMS-sum across excitation sources (wave headings + rotor).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ops import transforms as tf
+from raft_tpu.ops.waves import get_psd, get_rms
+from raft_tpu.physics.mooring import mooring_force
+from raft_tpu.physics.statics import member_inertia
+
+RAD2DEG = 57.29577951308232
+
+
+def _chan(results, name, avg, amps, dw):
+    std = get_rms(amps)
+    results[f"{name}_avg"] = avg
+    results[f"{name}_std"] = std
+    results[f"{name}_max"] = avg + 3 * std
+    results[f"{name}_min"] = avg - 3 * std
+    results[f"{name}_PSD"] = get_psd(amps, dw, axis=0)
+    results[f"{name}_RA"] = amps
+
+
+def mooring_tension_vector(ms, r6):
+    """[T_endA..., T_endB...] per line — MoorPy getTensions layout
+    (end A = anchor for the supported designs)."""
+    _, info = mooring_force(ms, r6)
+    TA = jnp.sqrt(info["HA"] ** 2 + info["VA"] ** 2)
+    TB = jnp.sqrt(info["HF"] ** 2 + info["VF"] ** 2)
+    return jnp.concatenate([TA, TB])
+
+
+def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
+                    f_aero0=None):
+    """Channel statistics for one case.
+
+    Xi : (nWaves+1, nDOF, nw) response amplitudes (last row = rotor
+    excitation source); X0 : (nDOF,) mean offsets.
+    """
+    fs = model.fowtList[0]
+    w = jnp.asarray(model.w)
+    dw = float(model.w[1] - model.w[0])
+    results = {}
+
+    Xi = jnp.asarray(Xi)
+    X0 = jnp.asarray(X0)
+
+    # PRP motions: the root node sits at the origin for the supported
+    # topologies, so reduced DOFs are PRP motions directly
+    Xi_PRP = Xi
+    Xi0_PRP = X0
+
+    _chan(results, "surge", Xi0_PRP[0], Xi_PRP[:, 0, :], dw)
+    _chan(results, "sway", Xi0_PRP[1], Xi_PRP[:, 1, :], dw)
+    _chan(results, "heave", Xi0_PRP[2], Xi_PRP[:, 2, :], dw)
+    _chan(results, "roll", RAD2DEG * Xi0_PRP[3], RAD2DEG * Xi_PRP[:, 3, :], dw)
+    _chan(results, "pitch", RAD2DEG * Xi0_PRP[4], RAD2DEG * Xi_PRP[:, 4, :], dw)
+    _chan(results, "yaw", RAD2DEG * Xi0_PRP[5], RAD2DEG * Xi_PRP[:, 5, :], dw)
+
+    # ----- mooring tensions (moorMod 0; raft_fowt.py:2356-2399)
+    if model.ms is not None:
+        T_mean = mooring_tension_vector(model.ms, X0[:6])
+        # Tension Jacobian by CENTRAL DIFFERENCES with dx = 0.1: this is
+        # what MoorPy's getCoupledStiffness(tensions=True) does, and the
+        # catenary is nonlinear enough that the step size is visible in
+        # the tension spectra — replicated for parity.
+        dx = 0.1
+        eye = jnp.eye(6) * dx
+        f = lambda x: mooring_tension_vector(model.ms, x)
+        Jcols = [
+            (f(X0[:6] + eye[j]) - f(X0[:6] - eye[j])) / (2 * dx) for j in range(6)
+        ]
+        J = jnp.stack(Jcols, axis=1)
+        T_amps = jnp.einsum("tj,hjw->htw", J, Xi_PRP[:, :6, :])
+        T_std = jnp.sqrt(0.5 * jnp.sum(jnp.abs(T_amps) ** 2, axis=(0, 2)))
+        results["Tmoor_avg"] = T_mean
+        results["Tmoor_std"] = T_std
+        results["Tmoor_max"] = T_mean + 3 * T_std
+        results["Tmoor_min"] = T_mean - 3 * T_std
+        results["Tmoor_PSD"] = jnp.sum(0.5 * jnp.abs(T_amps) ** 2 / dw, axis=0)
+
+    # ----- nacelle accelerations (raft_fowt.py:2401-2444)
+    nrot = fs.nrotors
+    for key in ("AxRNA", "AyRNA", "AzRNA"):
+        for suf in ("std", "avg", "max", "min"):
+            results[f"{key}_{suf}"] = np.zeros(nrot)
+        results[f"{key}_PSD"] = np.zeros((model.nw, nrot))
+    results["Mbase_avg"] = np.zeros(nrot)
+    results["Mbase_std"] = np.zeros(nrot)
+    results["Mbase_PSD"] = np.zeros((model.nw, nrot))
+    results["Mbase_max"] = np.zeros(nrot)
+    results["Mbase_min"] = np.zeros(nrot)
+
+    stat = model.statics()
+    g = fs.g
+    for ir in range(nrot):
+        rot = fs.rotors[ir]
+        node = int(fs.rotor_node[ir])
+        # hub motion from the rigid-body transform of the rotor node
+        d = jnp.asarray(fs.node_r0[node])  # reference lever (zero pose)
+        H = tf.skew(d + Xi0_PRP[:3] * 0)   # reference uses current r; equal here
+        XiHub = jnp.einsum("ia,haw->hiw", model.hydro[0].Tn[node], Xi_PRP)
+
+        for ax, key in enumerate(("AxRNA", "AyRNA", "AzRNA")):
+            amps = XiHub[:, ax, :] * w**2
+            results[f"{key}_std"] = results[f"{key}_std"].copy()
+            results[f"{key}_std"][ir] = float(get_rms(amps))
+            results[f"{key}_PSD"][:, ir] = np.asarray(get_psd(amps, dw, axis=0))
+            if key == "AxRNA":
+                avg = abs(float(jnp.sin(X0[4])) * g)
+            elif key == "AyRNA":
+                avg = abs(float(jnp.sin(X0[3])) * g)
+            else:
+                avg = abs(g)
+            results[f"{key}_avg"][ir] = avg
+            results[f"{key}_max"][ir] = avg + 3 * results[f"{key}_std"][ir]
+            results[f"{key}_min"][ir] = avg - 3 * results[f"{key}_std"][ir]
+
+        # ----- rigid tower base bending moment (raft_fowt.py:2504-2538)
+        tower_idx = [i for i, m in enumerate(fs.members) if m.part_of == "tower"]
+        if not tower_idx:
+            continue
+        mem_tower = fs.members[tower_idx[ir]]
+        mtower = float(stat["mtower"][ir])
+        rCG_tow = np.asarray(stat["rCG_tow"][ir])
+        m_turb = mtower + rot.mRNA
+        zCG = (rCG_tow[2] * mtower + rot.r_rel[2] * rot.mRNA) / m_turb
+        # tower base elevation at the DISPLACED pose (reference uses
+        # mem.rA which tracks the mean offset, raft_fowt.py:2512)
+        zBase = float(model.hydro[0].r_nodes[int(fs.member_node[tower_idx[ir]])][2])
+        hArm = zCG - zBase
+
+        M6_tow, _, _, _ = member_inertia(
+            mem_tower, jnp.asarray(mem_tower.R0), jnp.asarray(mem_tower.q0)
+        )
+        node_tow = int(fs.member_node[tower_idx[ir]])
+        ICG = float(
+            tf.translate_matrix_6to6(
+                M6_tow, jnp.asarray(fs.node_r0[node_tow] - np.array([0, 0, zCG]))
+            )[4, 4]
+        ) + rot.mRNA * (rot.r_rel[2] - zCG) ** 2 + rot.IrRNA
+
+        aCG = -(w**2) * (Xi_PRP[:, 0, :] + zCG * Xi_PRP[:, 4, :])
+        M_I = -m_turb * aCG * hArm - ICG * (-(w**2) * Xi_PRP[:, 4, :])
+        M_w = m_turb * g * hArm * Xi_PRP[:, 4, :]
+        if A_aero is not None:
+            M_X_aero = -(
+                -(w**2) * A_aero[0, 0, :] + 1j * w * B_aero[0, 0, :]
+            ) * (rot.r_rel[2] - zBase) ** 2 * Xi_PRP[:, 4, :]
+        else:
+            M_X_aero = 0.0
+        dyn_moment = M_I + M_w + M_X_aero
+        Mrms = float(get_rms(dyn_moment))
+        Mavg = m_turb * g * hArm * float(jnp.sin(X0[4]))
+        if f_aero0 is not None:
+            Fa = np.asarray(f_aero0)[:, ir]
+            Mavg += float(
+                tf.transform_force_6(jnp.asarray(Fa), jnp.asarray([0.0, 0.0, -hArm]))[4]
+            )
+        results["Mbase_avg"][ir] = Mavg
+        results["Mbase_std"][ir] = Mrms
+        results["Mbase_PSD"][:, ir] = np.asarray(get_psd(dyn_moment, dw, axis=0))
+        results["Mbase_max"][ir] = Mavg + 3 * Mrms
+        results["Mbase_min"][ir] = Mavg - 3 * Mrms
+
+    # wave elevation PSD (raft_fowt.py:2608)
+    results["wave_PSD"] = get_psd(jnp.asarray(zeta), dw, axis=0)
+    return results

@@ -1,0 +1,50 @@
+"""End-to-end analyzeCases parity (no-wind cases) vs reference goldens.
+
+Exercises the full chain: statics -> mooring equilibrium -> wave
+excitation -> iterative drag linearisation -> impedance solve ->
+response statistics, against *_true_analyzeCases.pkl.
+
+Only cases with wind_speed == 0 are compared until the aero module
+lands (wind cases additionally need rotor thrust/damping).
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+from tests.conftest import ref_data
+
+import raft_tpu
+
+METRICS = [
+    "wave_PSD", "surge_PSD", "sway_PSD", "heave_PSD", "roll_PSD",
+    "pitch_PSD", "yaw_PSD", "AxRNA_PSD", "Mbase_PSD", "Tmoor_PSD",
+]
+
+
+def test_analyze_cases_oc3_nowind():
+    path = ref_data("OC3spar.yaml")
+    if not os.path.exists(path):
+        pytest.skip("reference data unavailable")
+    model = raft_tpu.Model(path)
+    res = model.analyze_cases()
+    with open(path.replace(".yaml", "_true_analyzeCases.pkl"), "rb") as f:
+        true = pickle.load(f)
+
+    # case 0 has wind_speed == 0 (no aero); case 1 needs the aero module
+    iCase = 0
+    assert model.cases[iCase]["wind_speed"] == 0
+    for metric in METRICS:
+        a = np.asarray(res["case_metrics"][iCase][0][metric])
+        b = np.asarray(true["case_metrics"][iCase][0][metric])
+        if metric == "Tmoor_PSD":
+            # the reference's tension spectra inherit MoorPy's coarse
+            # 0.1-step finite-difference tension Jacobian (including a
+            # 0.1 *rad* rotational step); we replicate the secant but
+            # small catenary-model differences remain visible at ~3e-5
+            assert_allclose(a, b, rtol=3e-5, atol=1e-3, err_msg=metric)
+        else:
+            assert_allclose(a, b, rtol=1e-5, atol=1e-3, err_msg=metric)
