@@ -1,0 +1,296 @@
+"""Benchmark: frequency-domain design evaluations per second per chip.
+
+Workload (the reference's headline loop, SURVEY.md §6 / BASELINE.md):
+one full design evaluation = static equilibrium (catenary mooring
+Newton) + strip-theory wave excitation + iterative stochastic drag
+linearisation + per-frequency 6-DOF complex impedance solves + response
+spectra, on a spar design with ~80 Morison strips x 40 frequencies and
+10 linearisation iterations.
+
+* raft_tpu path: the jitted, vmapped evaluator from raft_tpu.api,
+  batched over sea states (the per-chip shard of a design sweep).
+* baseline: a straight serial NumPy implementation of the same math,
+  looping members/strips and frequencies the way the reference does
+  (raft_model.py:1084-1089, raft_member.py:1965-2124) — measured here
+  because the reference itself publishes no numbers and cannot run in
+  this image (its moorpy/ccblade deps are absent; see BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def build():
+    import raft_tpu
+    from raft_tpu.api import make_case_evaluator
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    model = raft_tpu.Model(os.path.join(here, "raft_tpu", "designs", "spar_demo.yaml"))
+    return model, make_case_evaluator(model)
+
+
+# --------------------------------------------------------------- baseline
+
+def numpy_eval_case(model, Hs, Tp, beta):
+    """Serial NumPy twin of one design evaluation (reference-style loops)."""
+    fs = model.fowtList[0]
+    fh = model.hydro[0]
+    ss = fh.strips
+    w = model.w
+    k = model.k
+    nw = len(w)
+    dw = w[1] - w[0]
+    rho, g, depth = fs.rho_water, fs.g, fs.depth
+
+    stat = model.statics()
+    K_h = np.asarray(stat["C_struc"] + stat["C_hydro"])
+    F_und = np.asarray(stat["W_struc"] + stat["W_hydro"])
+    M = np.asarray(stat["M_struc"]) + np.asarray(fh.hc0["A_hydro"])
+    Imat = np.asarray(fh.hc0["Imat"])  # (S,3,3,nw)
+    a_i = np.asarray(fh.hc0["a_i"])
+    ms = model.ms
+
+    # --- catenary (serial per line, Newton)
+    def line_force(r6):
+        from numpy import hypot
+
+        R = _rotmat(r6[3], r6[4], r6[5])
+        F = np.zeros(6)
+        K = np.zeros((6, 6))
+        for iL in range(ms.n_lines):
+            rf = r6[:3] + R @ ms.r_fair0[iL]
+            dv = rf - ms.r_anchor[iL]
+            XF, ZF = hypot(dv[0], dv[1]), dv[2]
+            HF, VF = _catenary_np(XF, ZF, ms.L[iL], ms.w[iL], ms.EA[iL])
+            uh = dv[:2] / max(XF, 1e-9)
+            f3 = np.array([-HF * uh[0], -HF * uh[1], -VF])
+            F[:3] += f3
+            F[3:] += np.cross(rf - r6[:3], f3)
+        return F
+
+    def line_stiffness(r6, dx=1e-4):
+        K = np.zeros((6, 6))
+        for j in range(6):
+            e = np.zeros(6)
+            e[j] = dx
+            K[:, j] = -(line_force(r6 + e) - line_force(r6 - e)) / (2 * dx)
+        return K
+
+    # --- static equilibrium (Newton, reference stopping rule)
+    X = np.zeros(6)
+    tols = np.array([0.05, 0.05, 0.05, 0.005, 0.005, 0.005])
+    for _ in range(30):
+        F = F_und - K_h @ X + line_force(X)
+        K = K_h + line_stiffness(X)
+        dX = np.linalg.solve(K, F)
+        if np.all(np.abs(dX) < tols):
+            break
+        X += dX
+
+    # --- strip frames at mean offset
+    Rp = _rotmat(X[3], X[4], X[5])
+    r0n = fs.node_r0
+    d = r0n - r0n[fs.root_id]
+    r_nodes = r0n + X[:3] + (d @ Rp.T - d)
+    q = ss.q0 @ Rp.T
+    p1 = ss.p10 @ Rp.T
+    p2 = ss.p20 @ Rp.T
+    r = r_nodes[ss.node] + q * ss.ls[:, None]
+    sub = r[:, 2] < 0
+    active = sub & ss.active
+
+    # --- sea state + per-strip wave kinematics & excitation (strip loop)
+    S = _jonswap_np(w, Hs, Tp)
+    zeta = np.sqrt(2 * S * dw).astype(complex)
+    Fexc = np.zeros((6, nw), dtype=complex)
+    u_all = np.zeros((ss.S, 3, nw), dtype=complex)
+    for s in range(ss.S):
+        u, ud, pd = _wavekin_np(zeta, beta, w, k, depth, r[s], rho, g)
+        u_all[s] = u
+        if not active[s]:
+            continue
+        F3 = np.einsum("ijw,jw->iw", Imat[s], ud) + pd[None, :] * (a_i[s] * q[s])[:, None]
+        lever = r[s] - r_nodes[ss.node[s]] + (r_nodes[ss.node[s]] - r_nodes[fs.root_id])
+        Fexc[:3] += F3
+        Fexc[3:] += np.cross(np.broadcast_to(lever[:, None], F3.shape), F3, axis=0)
+
+    C = K_h + line_stiffness(X)
+
+    # --- drag linearisation iterations + per-frequency solves
+    a_q = np.where(ss.circ, np.pi * ss.ds[:, 0] * ss.dls, 2 * (ss.ds[:, 0] + ss.ds[:, 0]) * ss.dls)
+    a_p1 = np.where(ss.circ, ss.ds[:, 0] * ss.dls, ss.ds[:, 0] * ss.dls)
+    a_p2 = np.where(ss.circ, ss.ds[:, 0] * ss.dls, ss.ds[:, 1] * ss.dls)
+    a_end = np.abs(np.where(
+        ss.circ, np.pi * ss.ds[:, 0] * ss.drs[:, 0],
+        (ss.ds[:, 0] + ss.drs[:, 0]) * (ss.ds[:, 1] + ss.drs[:, 1])
+        - (ss.ds[:, 0] - ss.drs[:, 0]) * (ss.ds[:, 1] - ss.drs[:, 1])))
+
+    XiLast = np.zeros((6, nw), dtype=complex)
+    Xi = XiLast
+    for _ in range(model.nIter + 1):
+        B6 = np.zeros((6, 6))
+        Fdrag = np.zeros((6, nw), dtype=complex)
+        for s in range(ss.S):  # strip loop, as the reference does
+            if not sub[s]:
+                continue
+            lever = r[s] - r_nodes[fs.root_id]
+            th = XiLast[3:]
+            vnode = 1j * w * (XiLast[:3] + np.cross(th, np.broadcast_to(lever[:, None], th.shape), axis=0))
+            vrel = u_all[s] - vnode
+            vq = q[s] @ vrel
+            vp1 = p1[s] @ vrel
+            vp2 = p2[s] @ vrel
+            vrel_p = vrel - vq[None, :] * q[s][:, None]
+            rms = lambda x: np.sqrt(0.5 * np.sum(np.abs(x) ** 2))
+            vq_r = rms(vq)
+            vp_r = rms(vrel_p)
+            c = np.sqrt(8 / np.pi) * 0.5 * rho
+            Bq = c * vq_r * a_q[s] * ss.Cd_q[s] + c * vq_r * a_end[s] * ss.Cd_End[s]
+            Bp1 = c * (vp_r if ss.circ[s] else rms(vp1)) * a_p1[s] * ss.Cd_p1[s]
+            Bp2 = c * (vp_r if ss.circ[s] else rms(vp2)) * a_p2[s] * ss.Cd_p2[s]
+            Bm = (Bq * np.outer(q[s], q[s]) + Bp1 * np.outer(p1[s], p1[s])
+                  + Bp2 * np.outer(p2[s], p2[s]))
+            H = _skew(lever)
+            B6[:3, :3] += Bm
+            B6[:3, 3:] += Bm @ H
+            B6[3:, :3] += (Bm @ H).T
+            B6[3:, 3:] += H @ Bm @ H.T
+            F3 = Bm @ u_all[s]
+            Fdrag[:3] += F3
+            Fdrag[3:] += np.cross(np.broadcast_to(lever[:, None], F3.shape), F3, axis=0)
+
+        Xi = np.zeros((6, nw), dtype=complex)
+        for i in range(nw):  # frequency loop, as the reference does
+            Z = -w[i] ** 2 * M + 1j * w[i] * B6 + C
+            Xi[:, i] = np.linalg.solve(Z, Fexc[:, i] + Fdrag[:, i])
+        tolCheck = np.abs(Xi - XiLast) / (np.abs(Xi) + 0.01)
+        if np.all(tolCheck < 0.01):
+            break
+        XiLast = 0.2 * XiLast + 0.8 * Xi
+
+    return 0.5 * np.abs(Xi) ** 2 / dw
+
+
+def _rotmat(x3, x2, x1):
+    s1, c1, s2, c2, s3, c3 = np.sin(x1), np.cos(x1), np.sin(x2), np.cos(x2), np.sin(x3), np.cos(x3)
+    return np.array([
+        [c1 * c2, c1 * s2 * s3 - c3 * s1, s1 * s3 + c1 * c3 * s2],
+        [c2 * s1, c1 * c3 + s1 * s2 * s3, c3 * s1 * s2 - c1 * s3],
+        [-s2, c2 * s3, c2 * c3]])
+
+
+def _skew(r):
+    return np.array([[0, r[2], -r[1]], [-r[2], 0, r[0]], [r[1], -r[0], 0]])
+
+
+def _jonswap_np(ws, Hs, Tp):
+    TpOvrSqrtHs = Tp / np.sqrt(Hs)
+    gamma = 5.0 if TpOvrSqrtHs <= 3.6 else 1.0 if TpOvrSqrtHs >= 5.0 else np.exp(5.75 - 1.15 * TpOvrSqrtHs)
+    f = 0.5 / np.pi * ws
+    fp4 = (Tp * f) ** -4.0
+    C = 1.0 - 0.287 * np.log(gamma)
+    sig = np.where(f <= 1.0 / Tp, 0.07, 0.09)
+    alpha = np.exp(-0.5 * ((f * Tp - 1.0) / sig) ** 2)
+    return 0.5 / np.pi * C * 0.3125 * Hs * Hs * fp4 / f * np.exp(-1.25 * fp4) * gamma**alpha
+
+
+def _wavekin_np(zeta, beta, w, k, h, r, rho, g):
+    x, y, z = r
+    ze = zeta * np.exp(-1j * k * (np.cos(beta) * x + np.sin(beta) * y))
+    if z > 0:
+        nw = len(w)
+        return (np.zeros((3, nw), complex), np.zeros((3, nw), complex), np.zeros(nw, complex))
+    kh = k * h
+    deep = kh > 89.4
+    with np.errstate(over="ignore"):
+        SINH = np.where(deep, np.exp(k * z), np.sinh(np.where(deep, 0, k * (z + h))) / np.sinh(np.where(deep, 1, kh)))
+        COSHs = np.where(deep, np.exp(k * z), np.cosh(np.where(deep, 0, k * (z + h))) / np.sinh(np.where(deep, 1, kh)))
+        COSHc = np.where(deep, np.exp(k * z), np.cosh(np.where(deep, 0, k * (z + h))) / np.cosh(np.where(deep, 1, kh)))
+    u = np.stack([w * ze * COSHs * np.cos(beta), w * ze * COSHs * np.sin(beta), 1j * w * ze * SINH])
+    return u, 1j * w * u, rho * g * ze * COSHc
+
+
+def _catenary_np(XF, ZF, L, w_line, EA, n_iter=60):
+    lr = np.hypot(XF, ZF)
+    lam = 0.2 if L <= lr else np.sqrt(max(3 * ((L**2 - ZF**2) / XF**2 - 1), 1e-12))
+    HF = max(abs(0.5 * w_line * XF / lam), 1e-3)
+    VF = 0.5 * w_line * (ZF / np.tanh(lam) + L)
+    for _ in range(n_iter):
+        def prof(HF, VF):
+            t1 = VF / HF
+            s1 = np.sqrt(1 + t1 * t1)
+            if VF < w_line * L:  # grounded
+                LB = L - VF / w_line
+                X = LB + HF / w_line * np.log(t1 + s1) + HF * L / EA
+                Z = HF / w_line * (s1 - 1) + VF**2 / (2 * EA * w_line)
+            else:
+                VA = VF - w_line * L
+                t2 = VA / HF
+                s2 = np.sqrt(1 + t2 * t2)
+                X = HF / w_line * (np.log(t1 + s1) - np.log(t2 + s2)) + HF * L / EA
+                Z = HF / w_line * (s1 - s2) + (VF * L - 0.5 * w_line * L**2) / EA
+            return X, Z
+        X0, Z0 = prof(HF, VF)
+        dh = max(1e-4 * HF, 1.0)
+        dv = max(1e-4 * abs(VF), 1.0)
+        Xh, Zh = prof(HF + dh, VF)
+        Xv, Zv = prof(HF, VF + dv)
+        J = np.array([[(Xh - X0) / dh, (Xv - X0) / dv], [(Zh - Z0) / dh, (Zv - Z0) / dv]])
+        rvec = np.array([X0 - XF, Z0 - ZF])
+        try:
+            dHV = np.linalg.solve(J, -rvec)
+        except np.linalg.LinAlgError:
+            break
+        HF = max(HF + np.clip(dHV[0], -0.5 * (abs(HF) + abs(VF) + 1), 0.5 * (abs(HF) + abs(VF) + 1)), 1e-6)
+        VF = VF + np.clip(dHV[1], -0.5 * (abs(HF) + abs(VF) + 1), 0.5 * (abs(HF) + abs(VF) + 1))
+        if np.hypot(*rvec) < 1e-8 * max(XF, 1.0):
+            break
+    return HF, VF
+
+
+# ------------------------------------------------------------------- main
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    model, evaluate = build()
+
+    # --- accelerator path: batched sweep on this chip
+    fn = jax.jit(jax.vmap(lambda h, t, b: evaluate(h, t, b)["PSD"]))
+    B = int(os.environ.get("RAFT_TPU_BENCH_BATCH", "512"))
+    rng = np.random.default_rng(0)
+    Hs = jnp.asarray(2.0 + 6.0 * rng.random(B), dtype=jnp.float32)
+    Tp = jnp.asarray(8.0 + 8.0 * rng.random(B), dtype=jnp.float32)
+    beta = jnp.asarray(2 * np.pi * rng.random(B), dtype=jnp.float32)
+    jax.block_until_ready(fn(Hs, Tp, beta))  # compile
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(fn(Hs, Tp, beta))
+    dt = (time.perf_counter() - t0) / reps
+    evals_per_sec = B / dt
+
+    # --- NumPy baseline (serial loops, reference structure)
+    n_base = 5
+    t0 = time.perf_counter()
+    for i in range(n_base):
+        numpy_eval_case(model, float(Hs[i]), float(Tp[i]), float(beta[i]))
+    base_dt = (time.perf_counter() - t0) / n_base
+    base_evals_per_sec = 1.0 / base_dt
+
+    print(json.dumps({
+        "metric": "design-evals/sec/chip (full freq-domain case evaluation)",
+        "value": round(evals_per_sec, 2),
+        "unit": "evals/s",
+        "vs_baseline": round(evals_per_sec / base_evals_per_sec, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

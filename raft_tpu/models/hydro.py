@@ -67,10 +67,15 @@ class FOWTHydro:
         # flow (calcHydroConstants is called with the FOWT at its
         # reference position, raft_model.py:620); only the wave-field
         # evaluation points and member axes track the mean offset.
-        r0_nodes, R0, root0 = platform_kinematics(fs, jnp.zeros(fs.nDOF))
-        Tn0 = node_T(r0_nodes, root0)
-        self.hc0 = morison.hydro_constants(fs, self.strips, R0, r0_nodes, Tn0)
-        self.set_position(np.zeros(fs.nDOF))
+        from raft_tpu.utils.devices import on_cpu, to_host
+
+        with on_cpu():
+            r0_nodes, R0, root0 = platform_kinematics(fs, jnp.zeros(fs.nDOF))
+            Tn0 = node_T(r0_nodes, root0)
+            self.hc0 = to_host(
+                morison.hydro_constants(fs, self.strips, R0, r0_nodes, Tn0)
+            )
+            self.set_position(np.zeros(fs.nDOF))
 
     def set_position(self, Xi0):
         self.Xi0 = jnp.asarray(Xi0, dtype=float)

@@ -61,10 +61,14 @@ class Model:
         return self._hydro
 
     def statics(self, Xi0=None):
-        """FOWT statics matrices (cached at the zero pose)."""
+        """FOWT statics matrices (cached at the zero pose; eager build
+        work pinned to the host backend)."""
+        from raft_tpu.utils.devices import on_cpu, to_host
+
         if Xi0 is None:
             if self._statics is None:
-                self._statics = calc_statics(self.fowtList[0])
+                with on_cpu():
+                    self._statics = to_host(calc_statics(self.fowtList[0]))
             return self._statics
         return calc_statics(self.fowtList[0], Xi0)
 

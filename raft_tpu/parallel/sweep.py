@@ -1,0 +1,53 @@
+"""Device-mesh sweep drivers: scale design/case evaluations over TPUs.
+
+The reference sweeps designs with nested serial Python loops
+(``/root/reference/raft/parametersweep.py:56-100``) and has no
+distributed backend (SURVEY.md §2.1).  Here a sweep is one batched
+tensor program laid out over a ``jax.sharding.Mesh``:
+
+* the **batch** axis (designs x cases — embarrassingly parallel, each a
+  ~6-DOF problem) shards over the ``dp`` mesh axis and rides ICI;
+* the **frequency** axis — the workload's 'sequence' axis — can shard
+  over ``sp``; the only cross-frequency couplings are the
+  drag-linearisation RMS statistics and the convergence norm
+  (raft_member.py:2084-2090), which XLA lowers to all-reduces when the
+  sharded program is compiled (the moral equivalent of context
+  parallelism for this physics).
+
+Everything goes through GSPMD: we annotate in/out shardings and let the
+compiler insert the collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices=None, axis_names=("dp",)):
+    devices = np.array(jax.devices()[: n_devices or len(jax.devices())])
+    if len(axis_names) == 1:
+        shape = (len(devices),)
+    else:
+        # split devices as evenly as possible over two axes
+        n = len(devices)
+        dp = max(d for d in range(1, n + 1) if n % d == 0 and d * d <= n)
+        shape = (n // dp, dp)
+    return Mesh(devices.reshape(shape), axis_names)
+
+
+def sweep_cases(evaluate, Hs, Tp, beta, mesh=None, out_keys=("PSD", "X0")):
+    """Evaluate a batch of sea states, sharded over the mesh's dp axis.
+
+    evaluate : scalar-case function from :func:`raft_tpu.api.make_case_evaluator`
+    Hs/Tp/beta : (N,) arrays (N divisible by the dp axis size)
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    batched = jax.vmap(lambda h, t, b: {k: evaluate(h, t, b)[k] for k in out_keys})
+    sharding = NamedSharding(mesh, P("dp"))
+    fn = jax.jit(batched, in_shardings=(sharding, sharding, sharding))
+    args = [jax.device_put(jnp.asarray(x), sharding) for x in (Hs, Tp, beta)]
+    return fn(*args)

@@ -1,0 +1,36 @@
+"""Device-placement helpers.
+
+Build-time model assembly does small *eager* jax computations (statics
+matrices, strip constants).  On TPU images those would otherwise land
+on the accelerator and then need device-to-host pulls when embedded as
+jit constants — and the axon TPU tunnel in this environment only
+implements f32 transfers.  ``on_cpu()`` pins eager build work to the
+host CPU backend; jitted hot-path programs still run wherever the
+caller places them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def on_cpu():
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        yield
+        return
+    with jax.default_device(cpu):
+        yield
+
+
+def to_host(tree):
+    """Pull a pytree of arrays to host numpy."""
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "dtype") else x, tree
+    )
