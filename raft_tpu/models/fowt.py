@@ -57,6 +57,7 @@ class RotorProps:
     Zhub: float
     I_drivetrain: float = 0.0
     aeroServoMod: int = 1
+    yaw_mode: int = 0
 
 
 class FOWTStructure:
@@ -184,6 +185,7 @@ class FOWTStructure:
             Zhub=r_rel[2] + q_rel[2] * overhang,
             I_drivetrain=float(coerce(turbine, "I_drivetrain", shape=nrotors, default=0.0)[ir]),
             aeroServoMod=int(coerce(turbine, "aeroServoMod", shape=nrotors, dtype=int, default=1)[ir]),
+            yaw_mode=int(coerce(turbine, "yaw_mode", shape=nrotors, dtype=int, default=0)[ir]),
         )
 
     # ------------------------------------------------------------------

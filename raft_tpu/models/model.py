@@ -52,6 +52,8 @@ class Model:
 
         self._hydro = None
         self._statics = None
+        self._rotor_aero = None
+        self._aero_cache = {}
 
     # ------------------------------------------------------------ lazy state
     @property
@@ -93,9 +95,87 @@ class Model:
         self.X0 = X
         return X
 
+    @property
+    def rotor_aero(self):
+        """Lazy per-rotor BEMT models (CCBlade-equivalent)."""
+        if self._rotor_aero is None:
+            from raft_tpu.physics.aero import build_rotor_aero
+
+            self._rotor_aero = []
+            turbine = self.design.get("turbine")
+            if turbine is not None and "wt_ops" in turbine:
+                t = dict(turbine)
+                site = self.design.get("site", {})
+                t.setdefault("nrotors", self.fowtList[0].nrotors)
+                t.setdefault("rho_air", coerce(site, "rho_air", default=1.225))
+                t.setdefault("mu_air", coerce(site, "mu_air", default=1.81e-5))
+                t.setdefault(
+                    "shearExp_air",
+                    coerce(site, "shearExp_air",
+                           default=coerce(site, "shearExp", default=0.12)),
+                )
+                for ir in range(self.fowtList[0].nrotors):
+                    self._rotor_aero.append(build_rotor_aero(t, ir))
+        return self._rotor_aero
+
+    def turbine_constants(self, case):
+        """Aero-servo added mass/damping/excitation + gyroscopics in the
+        reduced DOFs (FOWT.calcTurbineConstants equivalent,
+        raft_fowt.py:1514-1586).  Cached per case."""
+        from raft_tpu.physics.aero import calc_aero, operating_point
+        from raft_tpu.ops import transforms as tf
+
+        fs = self.fowtList[0]
+        nDOF, nw = fs.nDOF, self.nw
+        out = dict(
+            f_aero0=np.zeros((nDOF, max(fs.nrotors, 1))),
+            A_aero=np.zeros((nDOF, nDOF, nw)),
+            B_aero=np.zeros((nDOF, nDOF, nw)),
+            f_aero=np.zeros((nDOF, nw), dtype=complex),
+            B_gyro=np.zeros((nDOF, nDOF)),
+            A00=np.zeros((nw, max(fs.nrotors, 1))),
+            B00=np.zeros((nw, max(fs.nrotors, 1))),
+        )
+        status = str(case.get("turbine_status", "operating"))
+        if status != "operating" or not self.rotor_aero:
+            return out
+        key = tuple(sorted((k, str(v)) for k, v in case.items()
+                           if k in ("wind_speed", "wind_heading", "turbulence",
+                                    "yaw_misalign", "turbine_heading",
+                                    "current_speed", "current_heading",
+                                    "turbine_status")))
+        if key in self._aero_cache:
+            return self._aero_cache[key]
+
+        fh = self.hydro[0]
+        for ir, rot in enumerate(self.rotor_aero):
+            rprops = fs.rotors[ir]
+            speed = float(coerce(case, "wind_speed", shape=0, default=10))
+            if rprops.aeroServoMod <= 0 or speed <= 0:
+                continue
+            f0, f, a, b, info = calc_aero(rot, rprops, case, self.w)
+            node = int(fs.rotor_node[ir])
+            Tn = np.asarray(fh.Tn[node])  # (6, nDOF)
+            out["f_aero0"][:, ir] = Tn.T @ f0
+            out["f_aero"] += Tn.T @ f
+            for iw in range(nw):
+                out["A_aero"][:, :, iw] += Tn.T @ a[:, :, iw] @ Tn
+                out["B_aero"][:, :, iw] += Tn.T @ b[:, :, iw] @ Tn
+            out["A00"][:, ir] = a[0, 0, :]
+            out["B00"][:, ir] = b[0, 0, :]
+            # gyroscopic damping (raft_fowt.py:1569-1581)
+            Om_rpm = float(operating_point(rot, speed)[0])
+            IO = info["q"] * (rprops.I_drivetrain * Om_rpm * 2 * np.pi / 60)
+            G = np.zeros((6, 6))
+            G[3:, 3:] = np.asarray(tf.skew(jnp.asarray(IO)))
+            out["B_gyro"] += Tn.T @ G @ Tn
+        self._aero_cache[key] = out
+        return out
+
     def aero_mean_force(self, case):
-        """Mean rotor force; zero until the BEMT aero module lands."""
-        return jnp.zeros(self.fowtList[0].nDOF)
+        """Sum of mean rotor forces in reduced DOFs."""
+        tc = self.turbine_constants(case)
+        return jnp.asarray(np.sum(tc["f_aero0"], axis=1))
 
     # -------------------------------------------------------------- dynamics
     def solve_dynamics(self, case, X0=None):
@@ -122,10 +202,15 @@ class Model:
         A_BEM, B_BEM = self.bem_matrices()
         F_BEM = self.bem_excitation(case, fh)
 
+        tc = self.turbine_constants(case)
         M_lin = (
-            stat["M_struc"][:, :, None] + fh.hc0["A_hydro"][:, :, None] + A_BEM
+            jnp.asarray(tc["A_aero"])
+            + stat["M_struc"][:, :, None] + fh.hc0["A_hydro"][:, :, None] + A_BEM
         )
-        B_lin = zeros_mat + B_BEM
+        B_lin = (
+            jnp.asarray(tc["B_aero"]) + B_BEM
+            + jnp.asarray(tc["B_gyro"])[:, :, None]
+        )
         C_moor = jnp.zeros((nDOF, nDOF))
         if self.ms is not None:
             C_moor = C_moor.at[:6, :6].add(mooring_stiffness(self.ms, X0[:6]))
@@ -146,7 +231,7 @@ class Model:
         F_waves = jnp.stack(F_waves)
         Xi = system_response(Z, F_waves)
         Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=complex)], axis=0)
-        return Xi, dict(Z=Z, Bmat=Bmat, S=fh.S, zeta=fh.zeta, exc=exc)
+        return Xi, dict(Z=Z, Bmat=Bmat, S=fh.S, zeta=fh.zeta, exc=exc, tc=tc)
 
     def bem_matrices(self):
         """Potential-flow added mass / radiation damping (zero until the
@@ -175,6 +260,10 @@ class Model:
             X0 = self.solve_statics(case)
             self.results["mean_offsets"].append(np.asarray(X0))
             Xi, info = self.solve_dynamics(case, X0=X0)
-            metrics = turbine_outputs(self, case, X0, Xi, info["S"], info["zeta"])
+            metrics = turbine_outputs(
+                self, case, X0, Xi, info["S"], info["zeta"],
+                A_aero=info["tc"]["A00"].T, B_aero=info["tc"]["B00"].T,
+                f_aero0=info["tc"]["f_aero0"],
+            )
             self.results["case_metrics"][iCase] = {0: metrics}
         return self.results

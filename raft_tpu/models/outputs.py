@@ -154,8 +154,9 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
         M_I = -m_turb * aCG * hArm - ICG * (-(w**2) * Xi_PRP[:, 4, :])
         M_w = m_turb * g * hArm * Xi_PRP[:, 4, :]
         if A_aero is not None:
+            # A_aero/B_aero: (nrotors, nw) fore-aft coefficients at the hub
             M_X_aero = -(
-                -(w**2) * A_aero[0, 0, :] + 1j * w * B_aero[0, 0, :]
+                -(w**2) * jnp.asarray(A_aero[ir]) + 1j * w * jnp.asarray(B_aero[ir])
             ) * (rot.r_rel[2] - zBase) ** 2 * Xi_PRP[:, 4, :]
         else:
             M_X_aero = 0.0
@@ -163,9 +164,11 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
         Mrms = float(get_rms(dyn_moment))
         Mavg = m_turb * g * hArm * float(jnp.sin(X0[4]))
         if f_aero0 is not None:
-            Fa = np.asarray(f_aero0)[:, ir]
+            # reduced mean rotor force mapped back to the rotor node
+            # (raft_fowt.py:2533-2534 uses node.T @ f_aero0)
+            f6 = np.asarray(model.hydro[0].Tn[node]) @ np.asarray(f_aero0)[:, ir]
             Mavg += float(
-                tf.transform_force_6(jnp.asarray(Fa), jnp.asarray([0.0, 0.0, -hArm]))[4]
+                tf.transform_force_6(jnp.asarray(f6), jnp.asarray([0.0, 0.0, -hArm]))[4]
             )
         results["Mbase_avg"][ir] = Mavg
         results["Mbase_std"][ir] = Mrms

@@ -1,0 +1,82 @@
+"""Rotor BEMT validation vs the reference's CCBlade golden values.
+
+The reference's aero comes from CCBlade (Fortran BEM with hand-coded
+adjoints); ours is an independent jax BEMT using the same Ning (2014)
+residual formulation.  Small implementation differences (polar
+re-gridding, loss-factor details, integration rule) leave percent-level
+deviations, so this test asserts agreement at engineering tolerance on
+the dominant load channels; exact CCBlade twin-ing is tracked as a
+follow-up for golden-level wind-case parity.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+import yaml
+
+from tests.conftest import ref_data
+
+import jax.numpy as jnp
+from raft_tpu.ops import transforms as tf
+from raft_tpu.physics.aero import build_rotor_aero, operating_point, rotor_loads
+
+
+@pytest.fixture(scope="module")
+def rotor_and_golden():
+    path = ref_data("IEA15MW.yaml")
+    if not os.path.exists(path):
+        pytest.skip("reference data unavailable")
+    d = yaml.safe_load(open(path))
+    t = d["turbine"]
+    t["nrotors"] = 1
+    t["rho_air"] = d["site"]["rho_air"]
+    t["mu_air"] = d["site"]["mu_air"]
+    t["shearExp_air"] = d["site"].get("shearExp_air", d["site"].get("shearExp", 0.12))
+    rot = build_rotor_aero(t)
+    with open(ref_data("IEA15MW_true_calcAero-yaw_mode0.pkl"), "rb") as f:
+        true = pickle.load(f)
+    return rot, true
+
+
+def test_hub_loads_vs_ccblade(rotor_and_golden):
+    rot, true = rotor_and_golden
+    tilt = -6 * np.pi / 180
+    overhang = -12.0313
+
+    # all TI=0 yaw_mode-0 cases: sorted speeds x headings (test_rotor.py:102-127)
+    speeds = sorted([5, 15, 25, 10.59])
+    headings = [-45, 0, 45]
+    idx = 0
+    worst = 0.0
+    for ws in speeds:
+        for wh in headings:
+            for ti in [0, 0.5]:
+                case = true[idx]["case"]
+                assert case["wind_speed"] == ws and case["wind_heading"] == wh
+                if ti == 0:
+                    yaw = np.radians(wh)
+                    R = np.asarray(tf.rotation_matrix(0.0, -tilt, yaw))
+                    q = R @ np.array([1.0, 0, 0])
+                    yaw_mis = np.arctan2(q[1], q[0]) - np.radians(wh)
+                    tt = np.arctan2(q[2], np.hypot(q[0], q[1]))
+                    Om, pit = operating_point(rot, ws)
+                    loads = np.asarray(
+                        rotor_loads(rot, float(ws), float(Om), float(pit),
+                                    -float(tt), float(yaw_mis))
+                    )
+                    f0 = np.zeros(6)
+                    f0[:3] = R @ loads[:3]
+                    f0[3:] = R @ loads[3:]
+                    f0 = np.asarray(
+                        tf.transform_force_6(jnp.asarray(f0), jnp.asarray(q * overhang))
+                    )
+                    g = true[idx]["f_aero0"]
+                    # dominant channels: thrust-driven forces + shaft torque
+                    for comp in (0, 3):
+                        rel = abs(f0[comp] - g[comp]) / (abs(g[comp]) + 1e3)
+                        worst = max(worst, rel)
+                        assert rel < 0.06, (ws, wh, comp, rel, f0[comp], g[comp])
+                idx += 1
+    print(f"worst thrust/torque relative deviation vs CCBlade: {worst:.3f}")
