@@ -73,9 +73,12 @@ def test_hub_loads_vs_ccblade(rotor_and_golden):
                         tf.transform_force_6(jnp.asarray(f0), jnp.asarray(q * overhang))
                     )
                     g = true[idx]["f_aero0"]
-                    # dominant channels: thrust-driven forces + shaft torque
+                    # dominant channels: thrust-driven forces + shaft torque,
+                    # with a scale-aware denominator (torque crosses zero
+                    # near feather at high yaw, where rel error diverges)
+                    scale = 0.02 * np.max(np.abs(g))
                     for comp in (0, 3):
-                        rel = abs(f0[comp] - g[comp]) / (abs(g[comp]) + 1e3)
+                        rel = abs(f0[comp] - g[comp]) / (abs(g[comp]) + scale)
                         worst = max(worst, rel)
                         assert rel < 0.06, (ws, wh, comp, rel, f0[comp], g[comp])
                 idx += 1
