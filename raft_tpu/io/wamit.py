@@ -1,0 +1,189 @@
+"""WAMIT-format hydrodynamic coefficient file I/O.
+
+The reference consumes pre-computed potential-flow coefficients in the
+WAMIT interchange format via pyHAMS (``/root/reference/raft/
+raft_fowt.py:1444-1509`` readHydro; ``readQTF`` :2081-2129), which this
+framework keeps as its potential-flow interchange schema (SURVEY.md
+§7.1):
+
+* ``.1``  — added mass / radiation damping: rows of
+  [period, i, j, Abar(, Bbar)], nondimensional (A = rho Abar,
+  B = rho w Bbar).  Sentinel periods: T < 0 is zero frequency,
+  T = 0 is infinite frequency.
+* ``.3``  — excitation: [period, heading, i, |X|, phase, Re, Im]
+  (nondimensional; X = rho g Xbar).
+* ``.12d`` — difference-frequency QTFs.
+
+Parsing is numpy at build time; the interpolated model-grid tensors are
+constants for the traced solves.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def read_wamit1(path):
+    """Read a .1 file -> (w (nfreq,), A (6,6,nfreq), B (6,6,nfreq)),
+    nondimensional, sorted by ascending frequency.  Zero-frequency /
+    infinite-frequency sentinel rows are mapped to w = 0 / np.inf."""
+    data = np.loadtxt(path)
+    T = data[:, 0]
+    w = np.where(T < 0, 0.0, np.where(T == 0, np.inf, 2 * np.pi / np.where(T == 0, 1, T)))
+    freqs = np.unique(w)
+    A = np.zeros((6, 6, len(freqs)))
+    B = np.zeros((6, 6, len(freqs)))
+    idx = {f: n for n, f in enumerate(freqs)}
+    for row, wi in zip(data, w):
+        i, j = int(row[1]) - 1, int(row[2]) - 1
+        n = idx[wi]
+        A[i, j, n] = row[3]
+        if len(row) > 4:
+            B[i, j, n] = row[4]
+    return freqs, A, B
+
+
+def read_wamit3(path):
+    """Read a .3 file -> (w (nf,), headings (nh,), X (nh,6,nf) complex),
+    nondimensional."""
+    data = np.loadtxt(path)
+    T = data[:, 0]
+    w = np.where(T < 0, 0.0, np.where(T == 0, np.inf, 2 * np.pi / np.where(T == 0, 1, T)))
+    freqs = np.unique(w)
+    heads = np.unique(data[:, 1])
+    X = np.zeros((len(heads), 6, len(freqs)), dtype=complex)
+    fi = {f: n for n, f in enumerate(freqs)}
+    hi = {h: n for n, h in enumerate(heads)}
+    for row, wi in zip(data, w):
+        X[hi[row[1]], int(row[2]) - 1, fi[wi]] = row[5] + 1j * row[6]
+    return freqs, heads, X
+
+
+def _interp_freq(w_model, w_data, Y, pad_zero_freq=None):
+    """Linear interpolation along the last axis onto the model grid,
+    with an optional value prepended at w = 0 (the reference pads the
+    zero-frequency added mass / zero damping, raft_fowt.py:1469-1473)."""
+    finite = np.isfinite(w_data)
+    wd = w_data[finite]
+    Yd = Y[..., finite]
+    if pad_zero_freq is not None and (len(wd) == 0 or wd[0] > 0):
+        wd = np.hstack([[0.0], wd])
+        Yd = np.concatenate([pad_zero_freq[..., None], Yd], axis=-1)
+    out = np.zeros(Y.shape[:-1] + (len(w_model),))
+    for k in range(len(w_model)):
+        out[..., k] = _interp_point(w_model[k], wd, Yd)
+    return out
+
+
+def _interp_point(x, xs, Ys):
+    i = np.searchsorted(xs, x)
+    if i <= 0:
+        return Ys[..., 0]
+    if i >= len(xs):
+        return Ys[..., -1]
+    f = (x - xs[i - 1]) / (xs[i] - xs[i - 1])
+    return Ys[..., i - 1] * (1 - f) + Ys[..., i] * f
+
+
+def load_bem_coefficients(hydro_path, w_model, rho, g, r_ref=None):
+    """Model-grid BEM tensors from WAMIT files, reference conventions:
+
+    A_BEM (6,6,nw) = rho * Abar translated to the reference point;
+    B_BEM (6,6,nw) = rho * w * Bbar translated;
+    X coefficients (nh, 6, nw) rotated heading-relative
+    (raft_fowt.py:1476-1501).  Returns dict; X entries zero if no .3
+    file is present (the snapshot's OC4 dataset ships only the .1).
+    """
+    from raft_tpu.ops import transforms as tf
+    import jax.numpy as jnp
+
+    nw = len(w_model)
+    out = dict(
+        A_BEM=np.zeros((6, 6, nw)),
+        B_BEM=np.zeros((6, 6, nw)),
+        X_BEM=np.zeros((1, 6, nw), dtype=complex),
+        headings=np.array([0.0]),
+    )
+
+    p1 = hydro_path + ".1"
+    if os.path.exists(p1):
+        w1, Abar, Bbar = read_wamit1(p1)
+        # zero-frequency added mass used as the low-frequency pad
+        if np.any(w1 == 0):
+            A0 = Abar[:, :, np.where(w1 == 0)[0][0]]
+        else:
+            A0 = Abar[:, :, 0]
+        mask = np.isfinite(w1) & (w1 > 0)
+        A_i = _interp_freq(w_model, w1[mask], Abar[:, :, mask], pad_zero_freq=A0)
+        B_i = _interp_freq(w_model, w1[mask], Bbar[:, :, mask],
+                           pad_zero_freq=np.zeros((6, 6)))
+        r_off = np.zeros(3) if r_ref is None else -np.asarray(r_ref)
+        for iw in range(nw):
+            out["A_BEM"][:, :, iw] = np.asarray(
+                tf.translate_matrix_6to6(jnp.asarray(rho * A_i[:, :, iw]), jnp.asarray(r_off)))
+            out["B_BEM"][:, :, iw] = np.asarray(
+                tf.translate_matrix_6to6(jnp.asarray(rho * w_model[iw] * B_i[:, :, iw]), jnp.asarray(r_off)))
+
+    p3 = hydro_path + ".3"
+    if os.path.exists(p3):
+        w3, heads, Xbar = read_wamit3(p3)
+        heads = np.asarray(heads) % 360
+        order = np.argsort(heads)
+        heads = heads[order]
+        Xbar = Xbar[order]
+        mask = np.isfinite(w3) & (w3 > 0)
+        Xr = _interp_freq(w_model, w3[mask], Xbar.real[:, :, mask],
+                          pad_zero_freq=np.zeros((len(heads), 6)))
+        Xi = _interp_freq(w_model, w3[mask], Xbar.imag[:, :, mask],
+                          pad_zero_freq=np.zeros((len(heads), 6)))
+        X = rho * g * (Xr + 1j * Xi)
+        # rotate DOFs heading-relative (raft_fowt.py:1489-1498)
+        Xrot = np.zeros_like(X)
+        for ih, h in enumerate(heads):
+            ch, sh = np.cos(np.radians(h)), np.sin(np.radians(h))
+            Xrot[ih, 0] = ch * X[ih, 0] + sh * X[ih, 1]
+            Xrot[ih, 1] = -sh * X[ih, 0] + ch * X[ih, 1]
+            Xrot[ih, 2] = X[ih, 2]
+            Xrot[ih, 3] = ch * X[ih, 3] + sh * X[ih, 4]
+            Xrot[ih, 4] = -sh * X[ih, 3] + ch * X[ih, 4]
+            Xrot[ih, 5] = X[ih, 5]
+        out["X_BEM"] = Xrot
+        out["headings"] = heads
+
+    return out
+
+
+def interp_heading(X_BEM, headings, beta_deg):
+    """Wrap-around heading interpolation of excitation coefficients
+    (raft_fowt.py:1805-1833) + rotation back to the global frame
+    (:1837-1846).  Returns (6, nw) complex for one wave heading."""
+    beta = beta_deg % 360
+    nhs = len(headings)
+    if beta <= headings[0]:
+        hlast = headings[-1] - 360
+        i1, i2 = nhs - 1, 0
+        f2 = (beta - hlast) / (headings[0] - hlast)
+    elif beta >= headings[-1]:
+        hfirst = headings[0] + 360
+        i1, i2 = nhs - 1, 0
+        f2 = (beta - headings[-1]) / (hfirst - headings[-1])
+    else:
+        for i in range(nhs - 1):
+            if headings[i + 1] > beta:
+                i1, i2 = i, i + 1
+                f2 = (beta - headings[i]) / (headings[i + 1] - headings[i])
+                break
+    X_prime = X_BEM[i1] * (1 - f2) + X_BEM[i2] * f2
+
+    b = np.radians(beta_deg)
+    sb, cb = np.sin(b), np.cos(b)
+    X = np.zeros_like(X_prime)
+    X[0] = X_prime[0] * cb - X_prime[1] * sb
+    X[1] = X_prime[0] * sb + X_prime[1] * cb
+    X[2] = X_prime[2]
+    X[3] = X_prime[3] * cb - X_prime[4] * sb
+    X[4] = X_prime[3] * sb + X_prime[4] * cb
+    X[5] = X_prime[5]
+    return X

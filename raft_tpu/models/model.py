@@ -22,6 +22,11 @@ from raft_tpu.ops.waves import wave_number_ref
 
 class Model:
     def __init__(self, design):
+        self.base_dir = None
+        if isinstance(design, str):
+            import os
+
+            self.base_dir = os.path.dirname(os.path.abspath(design))
         design = load_design(design)
         self.design = design
 
@@ -233,17 +238,103 @@ class Model:
         Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=complex)], axis=0)
         return Xi, dict(Z=Z, Bmat=Bmat, S=fh.S, zeta=fh.zeta, exc=exc, tc=tc)
 
+    @property
+    def bem(self):
+        """Lazy potential-flow coefficients from WAMIT-format files
+        (readHydro equivalent, raft_fowt.py:1444-1509)."""
+        if not hasattr(self, "_bem"):
+            self._bem = None
+            fs = self.fowtList[0]
+            if fs.potFirstOrder == 1 and fs.hydroPath:
+                import os
+
+                from raft_tpu.io.wamit import load_bem_coefficients
+
+                path = fs.hydroPath
+                if self.base_dir is not None and not os.path.isabs(path):
+                    path = os.path.join(self.base_dir, path)
+                self._bem = load_bem_coefficients(
+                    path, self.w, fs.rho_water, fs.g,
+                    r_ref=fs.node_r0[fs.root_id],
+                )
+        return self._bem
+
     def bem_matrices(self):
-        """Potential-flow added mass / radiation damping (zero until the
-        WAMIT-file reader / native BEM solver milestones)."""
+        """Potential-flow added mass / radiation damping on the model
+        grid (zero when no coefficient files are configured)."""
         nDOF, nw = self.fowtList[0].nDOF, self.nw
-        z = jnp.zeros((nDOF, nDOF, nw))
-        return z, z
+        A = np.zeros((nDOF, nDOF, nw))
+        B = np.zeros((nDOF, nDOF, nw))
+        if self.bem is not None:
+            A[:6, :6, :] = self.bem["A_BEM"]
+            B[:6, :6, :] = self.bem["B_BEM"]
+        return jnp.asarray(A), jnp.asarray(B)
 
     def bem_excitation(self, case, fh):
+        """F_BEM per wave heading: heading-interpolated excitation
+        coefficients x component amplitudes (raft_fowt.py:1793-1849)."""
+        from raft_tpu.io.wamit import interp_heading
+        from raft_tpu.models.hydro import make_sea_state
+
         nDOF, nw = self.fowtList[0].nDOF, self.nw
         nWaves = 1 if np.isscalar(case.get("wave_heading", 0)) else len(case["wave_heading"])
-        return jnp.zeros((nWaves, nDOF, nw), dtype=complex)
+        F = np.zeros((nWaves, nDOF, nw), dtype=complex)
+        if self.bem is not None and np.any(np.abs(self.bem["X_BEM"]) > 0):
+            S, zeta, beta = make_sea_state(case, self.w)
+            heading = np.atleast_1d(np.degrees(beta))
+            for ih in range(nWaves):
+                X = interp_heading(self.bem["X_BEM"], self.bem["headings"], heading[ih])
+                F[ih, :6, :] = X * zeta[ih]
+        return jnp.asarray(F)
+
+    # --------------------------------------------------------------- eigen
+    def solve_eigen(self, case=None):
+        """Natural frequencies and modes (Model.solveEigen equivalent,
+        raft_model.py:436-547).  Call after solve_statics for a loaded
+        state (the mooring stiffness tracks the mean offsets).
+
+        Returns (fns [Hz], modes) with the reference's DOF-claiming
+        mode sort for rigid systems."""
+        from raft_tpu.physics.mooring import mooring_stiffness
+
+        fs = self.fowtList[0]
+        stat = self.statics()
+        X0 = getattr(self, "X0", None)
+        if X0 is None:
+            X0 = self.solve_statics(case)
+        A_BEM, _ = self.bem_matrices()
+        M_tot = (
+            np.asarray(stat["M_struc"]) + np.asarray(self.hydro[0].hc0["A_hydro"])
+            + np.asarray(A_BEM[:, :, 0])
+        )
+        C_tot = (
+            np.asarray(stat["C_struc"]) + np.asarray(stat["C_hydro"])
+            + np.asarray(stat["C_elast"])
+        )
+        if self.ms is not None:
+            C_tot[:6, :6] += np.asarray(mooring_stiffness(self.ms, jnp.asarray(X0[:6])))
+        C_tot[5, 5] += fs.yaw_stiffness
+
+        eigenvals, eigenvectors = np.linalg.eig(np.linalg.solve(M_tot, C_tot))
+        if np.any(eigenvals <= 0.0):
+            raise RuntimeError("zero or negative system eigenvalues detected")
+
+        nDOF = fs.nDOF
+        # DOF-claiming sort (raft_model.py:499-516)
+        ind_list = []
+        for i in range(nDOF - 1, -1, -1):
+            vec = np.abs(eigenvectors[i, :]).copy()
+            for _ in range(nDOF):
+                ind = int(np.argmax(vec))
+                if ind in ind_list:
+                    vec[ind] = 0.0
+                else:
+                    ind_list.append(ind)
+                    break
+        ind_list.reverse()
+        fns = np.sqrt(eigenvals[ind_list].real) / 2.0 / np.pi
+        modes = eigenvectors[:, ind_list]
+        return fns, modes
 
     # ---------------------------------------------------------- case driver
     def analyze_cases(self):

@@ -1,0 +1,41 @@
+"""Eigenanalysis parity vs the reference's hardcoded natural frequencies.
+
+Targets from /root/reference/tests/test_model.py:155-175 (unloaded
+cases: turbine idle, no environmental loads — the loaded cases need
+exact-CCBlade aero for the equilibrium point and are deferred with it).
+"""
+
+import os
+
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+from tests.conftest import ref_data
+
+import raft_tpu
+
+UNLOADED_CASE = {
+    "wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+    "turbine_status": "idle", "yaw_misalign": 0,
+    "wave_spectrum": "JONSWAP", "wave_period": 0, "wave_height": 0,
+    "wave_heading": 0, "current_speed": 0, "current_heading": 0,
+}
+
+TARGETS = {
+    "OC3spar.yaml": [0.00796903, 0.00796903, 0.03245079, 0.03383781, 0.03384323, 0.15347415],
+    "VolturnUS-S.yaml": [0.00782180, 0.00779927, 0.06073036, 0.03829455, 0.03823218, 0.01238992],
+    "VolturnUS-S-pointInertia.yaml": [0.00782029, 0.00779718, 0.06072388, 0.03804270, 0.03797990, 0.01238741],
+    "OC4semi-WAMIT_Coefs.yaml": [0.00884301, 0.00884300, 0.05733308, 0.04002449, 0.04003508, 0.01253087],
+}
+
+
+@pytest.mark.parametrize("design", list(TARGETS), ids=[d.split(".")[0] for d in TARGETS])
+def test_solve_eigen_unloaded(design):
+    path = ref_data(design)
+    if not os.path.exists(path):
+        pytest.skip("reference data unavailable")
+    model = raft_tpu.Model(path)
+    model.solve_statics(UNLOADED_CASE)
+    fns, modes = model.solve_eigen()
+    assert_allclose(fns, TARGETS[design], rtol=1e-5, atol=1e-5)
