@@ -80,10 +80,12 @@ class Model:
         return calc_statics(self.fowtList[0], Xi0)
 
     # --------------------------------------------------------------- statics
-    def solve_statics(self, case=None):
+    def solve_statics(self, case=None, extra_force=None):
         """Mean offsets for a load case (Model.solveStatics equivalent,
         raft_model.py:550-964; staticsMod=0 / forcingsMod=0 path).
 
+        extra_force: additional constant force (e.g. wave mean drift fed
+        back after the dynamics solve, raft_model.py:316-328).
         Returns the equilibrium pose X (nDOF,)."""
         fs = self.fowtList[0]
         stat = self.statics()
@@ -95,10 +97,30 @@ class Model:
             fh = self.hydro[0]
             F_env = F_env + fh.current_loads(case)
             F_env = F_env + self.aero_mean_force(case)
+        if extra_force is not None:
+            F_env = F_env + jnp.asarray(extra_force)
 
         X, Fres = solve_equilibrium(fs, self.ms, K_h, F_und, F_env)
         self.X0 = X
         return X
+
+    @property
+    def qtf(self):
+        """Lazy difference-frequency QTF data (potSecOrder == 2 path)."""
+        if not hasattr(self, "_qtf"):
+            self._qtf = None
+            fs = self.fowtList[0]
+            if fs.potSecOrder == 2 and fs.hydroPath:
+                import os
+
+                from raft_tpu.physics.secondorder import read_qtf_12d
+
+                path = fs.hydroPath + ".12d"
+                if self.base_dir is not None and not os.path.isabs(path):
+                    path = os.path.join(self.base_dir, path)
+                if os.path.exists(path):
+                    self._qtf = read_qtf_12d(path, rho=fs.rho_water, g=fs.g)
+        return self._qtf
 
     @property
     def rotor_aero(self):
@@ -222,6 +244,20 @@ class Model:
         C_lin = stat["C_struc"] + stat["C_hydro"] + C_moor + stat["C_elast"]
         F_lin = F_BEM[0] + exc["F_hydro_iner"][0]
 
+        # second-order (difference-frequency) forces from external QTFs
+        # (raft_model.py:1032-1048)
+        F_2nd = jnp.zeros((nWaves, nDOF, nw), dtype=complex)
+        F_2nd_mean = np.zeros((nWaves, nDOF))
+        if self.qtf is not None:
+            from raft_tpu.physics.secondorder import hydro_force_2nd
+
+            for ih in range(nWaves):
+                fm, f2 = hydro_force_2nd(self.qtf, fh.beta[ih], fh.S[ih], self.w)
+                F_2nd = F_2nd.at[ih, :6, :].add(jnp.asarray(f2[:6]))
+                F_2nd_mean[ih, :6] = fm[:6]
+            F_lin = F_lin + F_2nd[0]
+        self._last_drift_mean = F_2nd_mean
+
         Z, Xi1, Bmat = solve_dynamics_fowt(
             fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
             jnp.asarray(self.w), fh.Tn, fh.r_nodes,
@@ -232,7 +268,7 @@ class Model:
         F_waves = []
         for ih in range(nWaves):
             F_drag = fh.drag_excitation(Bmat, ih)
-            F_waves.append(F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag)
+            F_waves.append(F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag + F_2nd[ih])
         F_waves = jnp.stack(F_waves)
         Xi = system_response(Z, F_waves)
         Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=complex)], axis=0)
@@ -349,8 +385,13 @@ class Model:
         }
         for iCase, case in enumerate(self.cases):
             X0 = self.solve_statics(case)
-            self.results["mean_offsets"].append(np.asarray(X0))
             Xi, info = self.solve_dynamics(case, X0=X0)
+            # feed mean drift back into the equilibrium (raft_model.py:316-328)
+            if self.qtf is not None:
+                X0 = self.solve_statics(
+                    case, extra_force=np.sum(self._last_drift_mean, axis=0)
+                )
+            self.results["mean_offsets"].append(np.asarray(X0))
             metrics = turbine_outputs(
                 self, case, X0, Xi, info["S"], info["zeta"],
                 A_aero=info["tc"]["A00"].T, B_aero=info["tc"]["B00"].T,

@@ -1,0 +1,101 @@
+"""Second-order (difference-frequency) wave forces.
+
+Implements the externally-supplied-QTF path of the reference
+(``/root/reference/raft/raft_fowt.py``: ``readQTF`` :2081-2129 for the
+WAMIT ``.12d`` interchange format, ``calcHydroForce_2ndOrd``
+:2158-2253 for the Pinkster (1980) §IV.3 force-spectrum realisation).
+The slender-body internally-computed QTF (potSecOrder == 1) is a
+follow-up milestone.
+
+The force-spectrum evaluation ('qtf' interpolation mode, the reference
+default) is: bilinearly interpolate the QTF onto the model's w x w
+grid, then for each difference frequency mu_i sum the i-th
+superdiagonal against the shifted wave spectrum:
+
+    f(mu_i) = 4 sqrt( sum_j S(w_j) S(w_j+mu_i) |Q(w_j, w_j+mu_i)|^2 ) dw
+    f_mean  = 2 sum_j S(w_j) Re Q(w_j, w_j) dw
+
+which loses relative phase between components (as in the reference).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def read_qtf_12d(path, rho=1025.0, g=9.81, ulen=1.0, ndof=6):
+    """Read a WAMIT .12d difference-frequency QTF file.
+
+    Returns dict(w_2nd (nw,), heads_rad (nh,), qtf (nw, nw, nh, ndof))
+    — dimensionalised (rho g ULEN, extra ULEN for moments) and
+    hermitian-completed, matching readQTF (raft_fowt.py:2081-2129).
+    """
+    data = np.loadtxt(path)
+    data[:, 0:2] = 2.0 * np.pi / data[:, 0:2]  # periods -> rad/s
+    if not (data[:, 2] == data[:, 3]).all():
+        raise ValueError("only unidirectional QTFs are supported")
+    heads = np.deg2rad(np.sort(np.unique(data[:, 2])))
+    w1 = np.unique(data[:, 0])
+    w2 = np.unique(data[:, 1])
+    if not (w1 == w2).all():
+        raise ValueError("both frequency columns must contain the same values")
+
+    qtf = np.zeros([len(w1), len(w2), len(heads), ndof], dtype=complex)
+    for row in data:
+        i1 = np.searchsorted(w1, row[0])
+        i2 = np.searchsorted(w2, row[1])
+        ih = np.searchsorted(heads, np.deg2rad(row[2]))
+        idof = round(row[4] - 1)
+        factor = rho * g * ulen * (ulen if idof >= 3 else 1.0)
+        qtf[i1, i2, ih, idof] = factor * (row[7] + 1j * row[8])
+        if i1 != i2:  # hermitian completion
+            qtf[i2, i1, ih, idof] = factor * (row[7] - 1j * row[8])
+    return dict(w_2nd=w1, heads_rad=heads, qtf=qtf)
+
+
+def _interp_heading(qtf, heads, beta):
+    if len(heads) == 1:
+        return qtf[:, :, 0, :]
+    b = np.clip(beta, heads[0], heads[-1])
+    i = np.clip(np.searchsorted(heads, b) - 1, 0, len(heads) - 2)
+    f = (b - heads[i]) / (heads[i + 1] - heads[i])
+    return qtf[:, :, i, :] * (1 - f) + qtf[:, :, i + 1, :] * f
+
+
+def hydro_force_2nd(qtf_data, beta, S0, w):
+    """Mean drift + difference-frequency force amplitudes.
+
+    calcHydroForce_2ndOrd 'qtf' mode (raft_fowt.py:2218-2245).
+    Returns (f_mean (ndof,), f (ndof, nw) real amplitudes).
+    """
+    from scipy.interpolate import RegularGridInterpolator
+
+    w = np.asarray(w)
+    S0 = np.asarray(S0)
+    nw = len(w)
+    dw = w[1] - w[0]
+    ndof = qtf_data["qtf"].shape[-1]
+    w2nd = qtf_data["w_2nd"]
+    Q_beta = _interp_heading(qtf_data["qtf"], qtf_data["heads_rad"], beta)
+
+    f = np.zeros((ndof, nw))
+    f_mean = np.zeros(ndof)
+    pts = np.stack(np.meshgrid(w, w, indexing="ij"), axis=-1).reshape(-1, 2)
+    for idof in range(ndof):
+        Qr = RegularGridInterpolator((w2nd, w2nd), Q_beta[:, :, idof].real,
+                                     bounds_error=False, fill_value=0)(pts)
+        Qi = RegularGridInterpolator((w2nd, w2nd), Q_beta[:, :, idof].imag,
+                                     bounds_error=False, fill_value=0)(pts)
+        Q = (Qr + 1j * Qi).reshape(nw, nw)
+        for imu in range(1, nw):
+            Saux = np.zeros(nw)
+            Saux[: nw - imu] = S0[imu:]
+            Qd = np.zeros(nw, dtype=complex)
+            Qd[: nw - imu] = np.diag(Q, imu)
+            f[idof, imu] = 4 * np.sqrt(np.sum(S0 * Saux * np.abs(Qd) ** 2)) * dw
+        f_mean[idof] = 2 * np.sum(S0 * np.diag(Q.real)) * dw
+
+    # shift difference frequencies onto the model grid (raft_fowt.py:2241-2245)
+    f[:, 0:-1] = f[:, 1:]
+    f[:, -1] = 0
+    return f_mean, f
