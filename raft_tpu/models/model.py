@@ -43,17 +43,64 @@ class Model:
 
         self.cases = parse_cases(design)
 
-        # single-FOWT mode (array mode in a later milestone)
-        self.fowtList = [FOWTStructure(design, depth=self.depth)]
-        self.nDOF = sum(f.nDOF for f in self.fowtList)
+        # ---- FOWT list: single-unit or array mode (raft_model.py:67-162)
+        self.fowtList = []
+        self.ms_list = []
+        self.ms_array = None
+        if "array" in design:
+            if "turbine" in design and "turbines" not in design:
+                design["turbines"] = [design["turbine"]]
+            if "platform" in design and "platforms" not in design:
+                design["platforms"] = [design["platform"]]
+            if "mooring" in design and "moorings" not in design:
+                design["moorings"] = [design["mooring"]]
+            fowtInfo = [dict(zip(design["array"]["keys"], row))
+                        for row in design["array"]["data"]]
+            for info in fowtInfo:
+                design_i = {"site": design["site"],
+                            "settings": design.get("settings", {})}
+                if info["turbineID"] != 0:
+                    design_i["turbine"] = design["turbines"][info["turbineID"] - 1]
+                design_i["platform"] = design["platforms"][info["platformID"] - 1]
+                if info["mooringID"] != 0:
+                    design_i["mooring"] = design["moorings"][info["mooringID"] - 1]
+                fs = FOWTStructure(
+                    design_i, depth=self.depth,
+                    x_ref=info["x_location"], y_ref=info["y_location"],
+                    heading_adjust=info.get("heading_adjust", 0),
+                )
+                self.fowtList.append(fs)
+                if "mooring" in design_i and isinstance(design_i["mooring"], dict):
+                    self.ms_list.append(build_mooring(
+                        design_i["mooring"], rho_water=fs.rho_water, g=fs.g,
+                        x_ref=info["x_location"], y_ref=info["y_location"],
+                        heading_adjust=info.get("heading_adjust", 0)))
+                else:
+                    self.ms_list.append(None)
+            if "array_mooring" in design and design["array_mooring"].get("file"):
+                import os
 
-        # mooring system (jax catenary equivalent of the FOWT-level
-        # MoorPy system, raft_fowt.py:346-372)
-        fs = self.fowtList[0]
-        if "mooring" in design and isinstance(design["mooring"], dict):
-            self.ms = build_mooring(design["mooring"], rho_water=fs.rho_water, g=fs.g)
+                from raft_tpu.physics.mooring import parse_moordyn
+
+                fpath = design["array_mooring"]["file"]
+                if self.base_dir is not None and not os.path.isabs(fpath):
+                    fpath = os.path.join(self.base_dir, fpath)
+                self.ms_array = parse_moordyn(
+                    fpath, self.depth, rho=self.fowtList[0].rho_water,
+                    g=self.fowtList[0].g)
         else:
-            self.ms = None
+            self.fowtList.append(FOWTStructure(design, depth=self.depth))
+            fs = self.fowtList[0]
+            if "mooring" in design and isinstance(design["mooring"], dict):
+                self.ms_list.append(
+                    build_mooring(design["mooring"], rho_water=fs.rho_water, g=fs.g))
+            else:
+                self.ms_list.append(None)
+
+        self.nFOWT = len(self.fowtList)
+        self.nDOF = sum(f.nDOF for f in self.fowtList)
+        self.dof_offsets = np.cumsum([0] + [f.nDOF for f in self.fowtList])
+        self.ms = self.ms_list[0]  # single-FOWT convenience alias
 
         self._hydro = None
         self._statics = None
@@ -67,7 +114,7 @@ class Model:
             self._hydro = [FOWTHydro(f, self.w, self.k) for f in self.fowtList]
         return self._hydro
 
-    def statics(self, Xi0=None):
+    def statics(self, ifowt=0, Xi0=None):
         """FOWT statics matrices (cached at the zero pose; eager build
         work pinned to the host backend)."""
         from raft_tpu.utils.devices import on_cpu, to_host
@@ -75,9 +122,53 @@ class Model:
         if Xi0 is None:
             if self._statics is None:
                 with on_cpu():
-                    self._statics = to_host(calc_statics(self.fowtList[0]))
-            return self._statics
-        return calc_statics(self.fowtList[0], Xi0)
+                    self._statics = [
+                        to_host(calc_statics(f)) for f in self.fowtList
+                    ]
+            return self._statics[ifowt]
+        return calc_statics(self.fowtList[ifowt], Xi0)
+
+    def _mooring_closures(self):
+        """Total mooring force/stiffness over all FOWTs + shared lines."""
+        from raft_tpu.physics.mooring import mooring_force, mooring_stiffness
+
+        offs = self.dof_offsets
+
+        def force(X):
+            F = jnp.zeros(self.nDOF)
+            for i, ms in enumerate(self.ms_list):
+                if ms is not None:
+                    Fm, _ = mooring_force(ms, X[offs[i]:offs[i] + 6])
+                    F = F.at[offs[i]:offs[i] + 6].add(Fm)
+            if self.ms_array is not None:
+                r6_all = jnp.stack(
+                    [X[offs[i]:offs[i] + 6] for i in range(self.nFOWT)]
+                )
+                Fa, _ = self.ms_array.body_forces(r6_all)
+                for i in range(self.nFOWT):
+                    F = F.at[offs[i]:offs[i] + 6].add(Fa[i])
+            return F
+
+        def stiff(X):
+            K = jnp.zeros((self.nDOF, self.nDOF))
+            for i, ms in enumerate(self.ms_list):
+                if ms is not None:
+                    K = K.at[offs[i]:offs[i] + 6, offs[i]:offs[i] + 6].add(
+                        mooring_stiffness(ms, X[offs[i]:offs[i] + 6])
+                    )
+            if self.ms_array is not None:
+                r6_all = jnp.stack(
+                    [X[offs[i]:offs[i] + 6] for i in range(self.nFOWT)]
+                )
+                Ka = self.ms_array.stiffness(r6_all)
+                for i in range(self.nFOWT):
+                    for j in range(self.nFOWT):
+                        K = K.at[offs[i]:offs[i] + 6, offs[j]:offs[j] + 6].add(
+                            Ka[6 * i:6 * i + 6, 6 * j:6 * j + 6]
+                        )
+            return K
+
+        return force, stiff
 
     # --------------------------------------------------------------- statics
     def solve_statics(self, case=None, extra_force=None):
@@ -86,21 +177,34 @@ class Model:
 
         extra_force: additional constant force (e.g. wave mean drift fed
         back after the dynamics solve, raft_model.py:316-328).
-        Returns the equilibrium pose X (nDOF,)."""
-        fs = self.fowtList[0]
-        stat = self.statics()
-        K_h = stat["C_struc"] + stat["C_hydro"]
-        F_und = stat["W_struc"] + stat["W_hydro"] + stat["f0_additional"]
+        Returns the equilibrium pose X (nDOF,) over all FOWTs."""
+        from raft_tpu.models.statics_solve import (
+            make_tolerances, solve_equilibrium_general)
 
-        F_env = jnp.zeros(fs.nDOF)
-        if case is not None:
-            fh = self.hydro[0]
-            F_env = F_env + fh.current_loads(case)
-            F_env = F_env + self.aero_mean_force(case)
+        import scipy.linalg
+
+        K_blocks, F_und_parts, F_env_parts = [], [], []
+        for i, fs in enumerate(self.fowtList):
+            stat = self.statics(i)
+            K_blocks.append(np.asarray(stat["C_struc"] + stat["C_hydro"]))
+            F_und_parts.append(
+                np.asarray(stat["W_struc"] + stat["W_hydro"] + stat["f0_additional"]))
+            F_env = jnp.zeros(fs.nDOF)
+            if case is not None:
+                F_env = F_env + self.hydro[i].current_loads(case)
+                F_env = F_env + self.aero_mean_force(case, i)
+            F_env_parts.append(np.asarray(F_env))
+
+        K_h = jnp.asarray(scipy.linalg.block_diag(*K_blocks))
+        F_und = jnp.asarray(np.concatenate(F_und_parts))
+        F_env = jnp.asarray(np.concatenate(F_env_parts))
         if extra_force is not None:
             F_env = F_env + jnp.asarray(extra_force)
 
-        X, Fres = solve_equilibrium(fs, self.ms, K_h, F_und, F_env)
+        tol_vec, caps, refs = make_tolerances(self.fowtList)
+        force, stiff = self._mooring_closures()
+        X, Fres = solve_equilibrium_general(
+            K_h, F_und, F_env, force, stiff, tol_vec, caps, refs)
         self.X0 = X
         return X
 
@@ -145,14 +249,14 @@ class Model:
                     self._rotor_aero.append(build_rotor_aero(t, ir))
         return self._rotor_aero
 
-    def turbine_constants(self, case):
+    def turbine_constants(self, case, ifowt=0):
         """Aero-servo added mass/damping/excitation + gyroscopics in the
         reduced DOFs (FOWT.calcTurbineConstants equivalent,
         raft_fowt.py:1514-1586).  Cached per case."""
         from raft_tpu.physics.aero import calc_aero, operating_point
         from raft_tpu.ops import transforms as tf
 
-        fs = self.fowtList[0]
+        fs = self.fowtList[ifowt]
         nDOF, nw = fs.nDOF, self.nw
         out = dict(
             f_aero0=np.zeros((nDOF, max(fs.nrotors, 1))),
@@ -166,15 +270,15 @@ class Model:
         status = str(case.get("turbine_status", "operating"))
         if status != "operating" or not self.rotor_aero:
             return out
-        key = tuple(sorted((k, str(v)) for k, v in case.items()
-                           if k in ("wind_speed", "wind_heading", "turbulence",
-                                    "yaw_misalign", "turbine_heading",
-                                    "current_speed", "current_heading",
-                                    "turbine_status")))
+        key = (ifowt,) + tuple(sorted(
+            (k, str(v)) for k, v in case.items()
+            if k in ("wind_speed", "wind_heading", "turbulence",
+                     "yaw_misalign", "turbine_heading",
+                     "current_speed", "current_heading", "turbine_status")))
         if key in self._aero_cache:
             return self._aero_cache[key]
 
-        fh = self.hydro[0]
+        fh = self.hydro[ifowt]
         for ir, rot in enumerate(self.rotor_aero):
             rprops = fs.rotors[ir]
             speed = float(coerce(case, "wind_speed", shape=0, default=10))
@@ -199,9 +303,9 @@ class Model:
         self._aero_cache[key] = out
         return out
 
-    def aero_mean_force(self, case):
+    def aero_mean_force(self, case, ifowt=0):
         """Sum of mean rotor forces in reduced DOFs."""
-        tc = self.turbine_constants(case)
+        tc = self.turbine_constants(case, ifowt)
         return jnp.asarray(np.sum(tc["f_aero0"], axis=1))
 
     # -------------------------------------------------------------- dynamics
@@ -209,70 +313,102 @@ class Model:
         """Iterative linearised dynamics for one case
         (Model.solveDynamics equivalent, raft_model.py:966-1255).
 
-        Returns (Xi (nWaves+1, nDOF, nw), diagnostics dict)."""
+        Per-FOWT impedances converge independently (raft_model.py:994),
+        then the system impedance couples them through shared mooring
+        stiffness (:1164-1182) and the response is solved per heading.
+
+        Returns (Xi (nWaves+1, nDOF_total, nw), diagnostics dict)."""
         from raft_tpu.models.dynamics import solve_dynamics_fowt, system_response
-        from raft_tpu.physics import morison
         from raft_tpu.physics.mooring import mooring_stiffness
 
-        fs = self.fowtList[0]
-        fh = self.hydro[0]
         if X0 is None:
             X0 = self.solve_statics(case)
-        fh.set_position(X0)
+        offs = self.dof_offsets
+        nw = self.nw
 
-        stat = self.statics()  # reference-pose statics (staticsMod=0 flow)
-        exc = fh.hydro_excitation(case)
-        nWaves = exc["F_hydro_iner"].shape[0]
+        Z_blocks, Bmats, infos = [], [], []
+        F_2nd_mean = None
+        nWaves = 1 if np.isscalar(case.get("wave_heading", 0)) else len(case["wave_heading"])
+        F_waves = [[] for _ in range(nWaves)]
 
-        nDOF, nw = fs.nDOF, self.nw
-        zeros_mat = jnp.zeros((nDOF, nDOF, nw))
-        A_BEM, B_BEM = self.bem_matrices()
-        F_BEM = self.bem_excitation(case, fh)
+        for i, fs in enumerate(self.fowtList):
+            fh = self.hydro[i]
+            fh.set_position(X0[offs[i]:offs[i + 1]])
+            stat = self.statics(i)
+            exc = fh.hydro_excitation(case)
+            nDOF = fs.nDOF
 
-        tc = self.turbine_constants(case)
-        M_lin = (
-            jnp.asarray(tc["A_aero"])
-            + stat["M_struc"][:, :, None] + fh.hc0["A_hydro"][:, :, None] + A_BEM
-        )
-        B_lin = (
-            jnp.asarray(tc["B_aero"]) + B_BEM
-            + jnp.asarray(tc["B_gyro"])[:, :, None]
-        )
-        C_moor = jnp.zeros((nDOF, nDOF))
-        if self.ms is not None:
-            C_moor = C_moor.at[:6, :6].add(mooring_stiffness(self.ms, X0[:6]))
-        C_lin = stat["C_struc"] + stat["C_hydro"] + C_moor + stat["C_elast"]
-        F_lin = F_BEM[0] + exc["F_hydro_iner"][0]
+            A_BEM, B_BEM = self.bem_matrices(i)
+            F_BEM = self.bem_excitation(case, fh, i)
+            tc = self.turbine_constants(case, i)
 
-        # second-order (difference-frequency) forces from external QTFs
-        # (raft_model.py:1032-1048)
-        F_2nd = jnp.zeros((nWaves, nDOF, nw), dtype=complex)
-        F_2nd_mean = np.zeros((nWaves, nDOF))
-        if self.qtf is not None:
-            from raft_tpu.physics.secondorder import hydro_force_2nd
+            M_lin = (
+                jnp.asarray(tc["A_aero"])
+                + stat["M_struc"][:, :, None] + fh.hc0["A_hydro"][:, :, None] + A_BEM
+            )
+            B_lin = (
+                jnp.asarray(tc["B_aero"]) + B_BEM
+                + jnp.asarray(tc["B_gyro"])[:, :, None]
+            )
+            C_moor = jnp.zeros((nDOF, nDOF))
+            if self.ms_list[i] is not None:
+                C_moor = C_moor.at[:6, :6].add(
+                    mooring_stiffness(self.ms_list[i], X0[offs[i]:offs[i] + 6]))
+            C_lin = stat["C_struc"] + stat["C_hydro"] + C_moor + stat["C_elast"]
+            F_lin = F_BEM[0] + exc["F_hydro_iner"][0]
 
+            # second-order (difference-frequency) forces from external QTFs
+            F_2nd = jnp.zeros((nWaves, nDOF, nw), dtype=complex)
+            if F_2nd_mean is None:
+                F_2nd_mean = np.zeros((nWaves, self.nDOF))
+            if self.qtf is not None and i == 0:
+                from raft_tpu.physics.secondorder import hydro_force_2nd
+
+                for ih in range(nWaves):
+                    fm, f2 = hydro_force_2nd(self.qtf, fh.beta[ih], fh.S[ih], self.w)
+                    F_2nd = F_2nd.at[ih, :6, :].add(jnp.asarray(f2[:6]))
+                    F_2nd_mean[ih, offs[i]:offs[i] + 6] = fm[:6]
+                F_lin = F_lin + F_2nd[0]
+
+            Z_i, _, Bmat = solve_dynamics_fowt(
+                fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
+                jnp.asarray(self.w), fh.Tn, fh.r_nodes,
+                n_iter=self.nIter, Xi_start=self.XiStart,
+            )
+            Z_blocks.append(Z_i)
+            Bmats.append(Bmat)
+            infos.append(dict(S=fh.S, zeta=fh.zeta, exc=exc, tc=tc))
             for ih in range(nWaves):
-                fm, f2 = hydro_force_2nd(self.qtf, fh.beta[ih], fh.S[ih], self.w)
-                F_2nd = F_2nd.at[ih, :6, :].add(jnp.asarray(f2[:6]))
-                F_2nd_mean[ih, :6] = fm[:6]
-            F_lin = F_lin + F_2nd[0]
+                F_drag = fh.drag_excitation(Bmat, ih)
+                F_waves[ih].append(
+                    F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag + F_2nd[ih])
         self._last_drift_mean = F_2nd_mean
 
-        Z, Xi1, Bmat = solve_dynamics_fowt(
-            fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
-            jnp.asarray(self.w), fh.Tn, fh.r_nodes,
-            n_iter=self.nIter, Xi_start=self.XiStart,
-        )
+        # ---- system impedance: block-diagonal FOWT impedances + shared
+        # mooring stiffness (raft_model.py:1164-1182)
+        Z_sys = jnp.zeros((nw, self.nDOF, self.nDOF), dtype=complex)
+        for i in range(self.nFOWT):
+            Z_sys = Z_sys.at[:, offs[i]:offs[i + 1], offs[i]:offs[i + 1]].add(
+                Z_blocks[i])
+        if self.ms_array is not None:
+            r6_all = jnp.stack(
+                [X0[offs[i]:offs[i] + 6] for i in range(self.nFOWT)])
+            Ka = self.ms_array.stiffness(r6_all)
+            for i in range(self.nFOWT):
+                for j in range(self.nFOWT):
+                    Z_sys = Z_sys.at[:, offs[i]:offs[i] + 6,
+                                     offs[j]:offs[j] + 6].add(
+                        Ka[6 * i:6 * i + 6, 6 * j:6 * j + 6][None])
 
-        # system response for each wave heading + rotor-excitation slot
-        F_waves = []
-        for ih in range(nWaves):
-            F_drag = fh.drag_excitation(Bmat, ih)
-            F_waves.append(F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag + F_2nd[ih])
-        F_waves = jnp.stack(F_waves)
-        Xi = system_response(Z, F_waves)
-        Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=complex)], axis=0)
-        return Xi, dict(Z=Z, Bmat=Bmat, S=fh.S, zeta=fh.zeta, exc=exc, tc=tc)
+        F_sys = jnp.stack([jnp.concatenate(Fw, axis=0) for Fw in F_waves])
+        Xi = system_response(Z_sys, F_sys)
+        Xi = jnp.concatenate(
+            [Xi, jnp.zeros((1, self.nDOF, nw), dtype=complex)], axis=0)
+        info0 = infos[0]
+        return Xi, dict(
+            Z=Z_sys, Bmat=Bmats[0], S=info0["S"], zeta=info0["zeta"],
+            exc=info0["exc"], tc=info0["tc"], infos=infos,
+        )
 
     @property
     def bem(self):
@@ -295,10 +431,10 @@ class Model:
                 )
         return self._bem
 
-    def bem_matrices(self):
+    def bem_matrices(self, ifowt=0):
         """Potential-flow added mass / radiation damping on the model
         grid (zero when no coefficient files are configured)."""
-        nDOF, nw = self.fowtList[0].nDOF, self.nw
+        nDOF, nw = self.fowtList[ifowt].nDOF, self.nw
         A = np.zeros((nDOF, nDOF, nw))
         B = np.zeros((nDOF, nDOF, nw))
         if self.bem is not None:
@@ -306,21 +442,30 @@ class Model:
             B[:6, :6, :] = self.bem["B_BEM"]
         return jnp.asarray(A), jnp.asarray(B)
 
-    def bem_excitation(self, case, fh):
+    def bem_excitation(self, case, fh, ifowt=0):
         """F_BEM per wave heading: heading-interpolated excitation
-        coefficients x component amplitudes (raft_fowt.py:1793-1849)."""
+        coefficients x component amplitudes, with the array phase offset
+        exp(-i k (x cos b + y sin b)) (raft_fowt.py:1793-1849)."""
         from raft_tpu.io.wamit import interp_heading
         from raft_tpu.models.hydro import make_sea_state
 
-        nDOF, nw = self.fowtList[0].nDOF, self.nw
+        fs = self.fowtList[ifowt]
+        nDOF, nw = fs.nDOF, self.nw
         nWaves = 1 if np.isscalar(case.get("wave_heading", 0)) else len(case["wave_heading"])
         F = np.zeros((nWaves, nDOF, nw), dtype=complex)
         if self.bem is not None and np.any(np.abs(self.bem["X_BEM"]) > 0):
             S, zeta, beta = make_sea_state(case, self.w)
             heading = np.atleast_1d(np.degrees(beta))
             for ih in range(nWaves):
-                X = interp_heading(self.bem["X_BEM"], self.bem["headings"], heading[ih])
-                F[ih, :6, :] = X * zeta[ih]
+                phase = np.exp(-1j * self.k * (
+                    fs.x_ref * np.cos(np.radians(heading[ih]))
+                    + fs.y_ref * np.sin(np.radians(heading[ih]))))
+                X = interp_heading(
+                    self.bem["X_BEM"], self.bem["headings"],
+                    (heading[ih] - fs.heading_adjust) % 360)
+                # interp_heading rotates by the BEM-frame heading; global
+                # rotation uses the absolute heading
+                F[ih, :6, :] = X * zeta[ih] * phase
         return jnp.asarray(F)
 
     # --------------------------------------------------------------- eigen
@@ -331,31 +476,34 @@ class Model:
 
         Returns (fns [Hz], modes) with the reference's DOF-claiming
         mode sort for rigid systems."""
-        from raft_tpu.physics.mooring import mooring_stiffness
-
-        fs = self.fowtList[0]
-        stat = self.statics()
         X0 = getattr(self, "X0", None)
         if X0 is None:
             X0 = self.solve_statics(case)
-        A_BEM, _ = self.bem_matrices()
-        M_tot = (
-            np.asarray(stat["M_struc"]) + np.asarray(self.hydro[0].hc0["A_hydro"])
-            + np.asarray(A_BEM[:, :, 0])
-        )
-        C_tot = (
-            np.asarray(stat["C_struc"]) + np.asarray(stat["C_hydro"])
-            + np.asarray(stat["C_elast"])
-        )
-        if self.ms is not None:
-            C_tot[:6, :6] += np.asarray(mooring_stiffness(self.ms, jnp.asarray(X0[:6])))
-        C_tot[5, 5] += fs.yaw_stiffness
+        offs = self.dof_offsets
+        M_tot = np.zeros((self.nDOF, self.nDOF))
+        C_tot = np.zeros((self.nDOF, self.nDOF))
+        for i, fs in enumerate(self.fowtList):
+            stat = self.statics(i)
+            A_BEM, _ = self.bem_matrices(i)
+            sl = slice(offs[i], offs[i + 1])
+            M_tot[sl, sl] += (
+                np.asarray(stat["M_struc"])
+                + np.asarray(self.hydro[i].hc0["A_hydro"])
+                + np.asarray(A_BEM[:, :, 0])
+            )
+            C_tot[sl, sl] += (
+                np.asarray(stat["C_struc"]) + np.asarray(stat["C_hydro"])
+                + np.asarray(stat["C_elast"])
+            )
+            C_tot[offs[i] + 5, offs[i] + 5] += fs.yaw_stiffness
+        _, stiff = self._mooring_closures()
+        C_tot += np.asarray(stiff(jnp.asarray(X0)))
 
         eigenvals, eigenvectors = np.linalg.eig(np.linalg.solve(M_tot, C_tot))
         if np.any(eigenvals <= 0.0):
             raise RuntimeError("zero or negative system eigenvalues detected")
 
-        nDOF = fs.nDOF
+        nDOF = self.nDOF
         # DOF-claiming sort (raft_model.py:499-516)
         ind_list = []
         for i in range(nDOF - 1, -1, -1):
@@ -392,10 +540,16 @@ class Model:
                     case, extra_force=np.sum(self._last_drift_mean, axis=0)
                 )
             self.results["mean_offsets"].append(np.asarray(X0))
-            metrics = turbine_outputs(
-                self, case, X0, Xi, info["S"], info["zeta"],
-                A_aero=info["tc"]["A00"].T, B_aero=info["tc"]["B00"].T,
-                f_aero0=info["tc"]["f_aero0"],
-            )
-            self.results["case_metrics"][iCase] = {0: metrics}
+            self.results["case_metrics"][iCase] = {}
+            offs = self.dof_offsets
+            for i in range(self.nFOWT):
+                tc_i = info["infos"][i]["tc"]
+                metrics = turbine_outputs(
+                    self, case, X0[offs[i]:offs[i + 1]],
+                    Xi[:, offs[i]:offs[i + 1], :],
+                    info["infos"][i]["S"], info["infos"][i]["zeta"],
+                    A_aero=tc_i["A00"].T, B_aero=tc_i["B00"].T,
+                    f_aero0=tc_i["f_aero0"], ifowt=i,
+                )
+                self.results["case_metrics"][iCase][i] = metrics
         return self.results

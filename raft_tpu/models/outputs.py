@@ -41,13 +41,13 @@ def mooring_tension_vector(ms, r6):
 
 
 def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
-                    f_aero0=None):
-    """Channel statistics for one case.
+                    f_aero0=None, ifowt=0):
+    """Channel statistics for one case and one FOWT.
 
-    Xi : (nWaves+1, nDOF, nw) response amplitudes (last row = rotor
-    excitation source); X0 : (nDOF,) mean offsets.
+    Xi : (nWaves+1, nDOF, nw) response amplitudes of THIS FOWT (last
+    row = rotor excitation source); X0 : (nDOF,) its mean offsets.
     """
-    fs = model.fowtList[0]
+    fs = model.fowtList[ifowt]
     w = jnp.asarray(model.w)
     dw = float(model.w[1] - model.w[0])
     results = {}
@@ -56,9 +56,11 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
     X0 = jnp.asarray(X0)
 
     # PRP motions: the root node sits at the origin for the supported
-    # topologies, so reduced DOFs are PRP motions directly
+    # topologies, so reduced DOFs are PRP motions directly; mean offsets
+    # are relative to the FOWT's array reference position
     Xi_PRP = Xi
-    Xi0_PRP = X0
+    ref = jnp.zeros(X0.shape[0]).at[0].set(fs.x_ref).at[1].set(fs.y_ref)
+    Xi0_PRP = X0 - ref
 
     _chan(results, "surge", Xi0_PRP[0], Xi_PRP[:, 0, :], dw)
     _chan(results, "sway", Xi0_PRP[1], Xi_PRP[:, 1, :], dw)
@@ -68,15 +70,16 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
     _chan(results, "yaw", RAD2DEG * Xi0_PRP[5], RAD2DEG * Xi_PRP[:, 5, :], dw)
 
     # ----- mooring tensions (moorMod 0; raft_fowt.py:2356-2399)
-    if model.ms is not None:
-        T_mean = mooring_tension_vector(model.ms, X0[:6])
+    ms = model.ms_list[ifowt]
+    if ms is not None:
+        T_mean = mooring_tension_vector(ms, X0[:6])
         # Tension Jacobian by CENTRAL DIFFERENCES with dx = 0.1: this is
         # what MoorPy's getCoupledStiffness(tensions=True) does, and the
         # catenary is nonlinear enough that the step size is visible in
         # the tension spectra — replicated for parity.
         dx = 0.1
         eye = jnp.eye(6) * dx
-        f = lambda x: mooring_tension_vector(model.ms, x)
+        f = lambda x: mooring_tension_vector(ms, x)
         Jcols = [
             (f(X0[:6] + eye[j]) - f(X0[:6] - eye[j])) / (2 * dx) for j in range(6)
         ]
@@ -101,7 +104,7 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
     results["Mbase_max"] = np.zeros(nrot)
     results["Mbase_min"] = np.zeros(nrot)
 
-    stat = model.statics()
+    stat = model.statics(ifowt)
     g = fs.g
     for ir in range(nrot):
         rot = fs.rotors[ir]
@@ -109,7 +112,7 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
         # hub motion from the rigid-body transform of the rotor node
         d = jnp.asarray(fs.node_r0[node])  # reference lever (zero pose)
         H = tf.skew(d + Xi0_PRP[:3] * 0)   # reference uses current r; equal here
-        XiHub = jnp.einsum("ia,haw->hiw", model.hydro[0].Tn[node], Xi_PRP)
+        XiHub = jnp.einsum("ia,haw->hiw", model.hydro[ifowt].Tn[node], Xi_PRP)
 
         for ax, key in enumerate(("AxRNA", "AyRNA", "AzRNA")):
             amps = XiHub[:, ax, :] * w**2
@@ -137,7 +140,7 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
         zCG = (rCG_tow[2] * mtower + rot.r_rel[2] * rot.mRNA) / m_turb
         # tower base elevation at the DISPLACED pose (reference uses
         # mem.rA which tracks the mean offset, raft_fowt.py:2512)
-        zBase = float(model.hydro[0].r_nodes[int(fs.member_node[tower_idx[ir]])][2])
+        zBase = float(model.hydro[ifowt].r_nodes[int(fs.member_node[tower_idx[ir]])][2])
         hArm = zCG - zBase
 
         M6_tow, _, _, _ = member_inertia(
@@ -166,7 +169,7 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
         if f_aero0 is not None:
             # reduced mean rotor force mapped back to the rotor node
             # (raft_fowt.py:2533-2534 uses node.T @ f_aero0)
-            f6 = np.asarray(model.hydro[0].Tn[node]) @ np.asarray(f_aero0)[:, ir]
+            f6 = np.asarray(model.hydro[ifowt].Tn[node]) @ np.asarray(f_aero0)[:, ir]
             Mavg += float(
                 tf.transform_force_6(jnp.asarray(f6), jnp.asarray([0.0, 0.0, -hArm]))[4]
             )

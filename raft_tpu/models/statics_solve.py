@@ -1,27 +1,123 @@
-"""Mean-offset (static equilibrium) solve for a FOWT.
+"""Mean-offset (static equilibrium) solve.
 
 Equivalent of ``Model.solveStatics`` (``/root/reference/raft/
 raft_model.py:550-964``) with the linearised-hydrostatics approach
 (staticsMod=0) and constant environmental forcing (forcingsMod=0):
 
-    F(X) = F_undisplaced - K_hydrostatic X + F_env + F_moor(X)
+    F(X) = F_undisplaced - K_hydrostatic (X - X_ref) + F_env + F_moor(X)
     K(X) = K_hydrostatic + C_elast + C_moor(X)
     X   <- X + K^{-1} F          (damped Newton)
 
-The mooring reaction and its exact tangent stiffness come from the jax
-catenary module, so the iteration is a clean Newton method (the
-reference's ad-hoc diagonal-inflation fallbacks, raft_model.py:847-878,
-are unnecessary).  The loop is a ``lax.while_loop`` so the whole
-equilibrium solve jits and vmaps over load cases and designs.
+Mooring reactions enter through caller-provided closures (single-FOWT
+catenary systems, per-FOWT systems in an array, and shared-line
+networks all compose into the same two functions), with exact tangent
+stiffness from the jax catenary module, so the iteration is a clean
+Newton method (the reference's ad-hoc diagonal-inflation fallbacks,
+raft_model.py:847-878, are unnecessary).  The loop is a
+``lax.while_loop`` so the whole equilibrium solve jits and vmaps over
+load cases and designs.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from raft_tpu.physics.mooring import mooring_force, mooring_stiffness
+
+
+def make_tolerances(fowtList):
+    """Per-DOF solver tolerances and step caps mirroring the reference
+    (0.05 m / 0.005 rad; 30 m / 5 m / 0.1 rad caps,
+    raft_model.py:658-669)."""
+    tols, caps, refs = [], [], []
+    for fs in fowtList:
+        for dof in fs.reducedDOF:
+            tols.append(0.05 if dof[1] < 3 else 0.005)
+            caps.append(30.0 if dof[1] < 2 else 5.0 if dof[1] == 2 else 0.1)
+            refs.append(
+                fs.x_ref if dof[1] == 0 else fs.y_ref if dof[1] == 1 else 0.0
+            )
+    return jnp.asarray(tols), jnp.asarray(caps), jnp.asarray(refs)
+
+
+def single_ms_closures(ms, nDOF):
+    """Force/stiffness closures for one FOWT's own catenary system."""
+
+    def force(X):
+        F = jnp.zeros(nDOF)
+        if ms is not None:
+            Fm, _ = mooring_force(ms, X[:6])
+            F = F.at[:6].add(Fm)
+        return F
+
+    def stiff(X):
+        K = jnp.zeros((nDOF, nDOF))
+        if ms is not None:
+            K = K.at[:6, :6].add(mooring_stiffness(ms, X[:6]))
+        return K
+
+    return force, stiff
+
+
+def solve_equilibrium_general(
+    K_hydrostatic,
+    F_undisplaced,
+    F_env,
+    mooring_force_fn,
+    mooring_stiffness_fn,
+    tol_vec,
+    step_cap,
+    X_ref,
+    C_elast=None,
+    X0=None,
+    max_iter=30,
+    discard_subtol_step=True,
+):
+    """Damped Newton equilibrium with the reference's stopping rule.
+
+    The hydrostatic reaction acts on the offset from the reference
+    position X_ref (array FOWTs sit at nonzero x/y; raft_model.py:698-707).
+    ``discard_subtol_step`` reproduces dsolve2's convergence semantics
+    (the final sub-tolerance step is not applied), which the reference's
+    published equilibria correspond to."""
+    nDOF = F_undisplaced.shape[0]
+    if X0 is None:
+        X0 = jnp.asarray(X_ref)
+    if C_elast is None:
+        C_elast = jnp.zeros((nDOF, nDOF))
+
+    def net_force(X):
+        return (
+            F_undisplaced
+            - K_hydrostatic @ (X - X_ref)
+            + F_env
+            + mooring_force_fn(X)
+            - C_elast @ (X - X_ref)
+        )
+
+    def step(X):
+        F = net_force(X)
+        K = K_hydrostatic + C_elast + mooring_stiffness_fn(X)
+        dX = jnp.linalg.solve(K, F)
+        return jnp.clip(dX, -step_cap, step_cap)
+
+    def body(carry):
+        X, it, _ = carry
+        dX = step(X)
+        done = jnp.all(jnp.abs(dX) < tol_vec)
+        if discard_subtol_step:
+            X = jnp.where(done, X, X + dX)
+        else:
+            X = X + dX
+        return X, it + 1, done
+
+    def cond(carry):
+        _, it, done = carry
+        return (it < max_iter) & (~done)
+
+    X, _, _ = jax.lax.while_loop(cond, body, (X0, 0, jnp.asarray(False)))
+    return X, net_force(X)
 
 
 def solve_equilibrium(
@@ -36,66 +132,15 @@ def solve_equilibrium(
     tol="reference",
     step_cap=None,
 ):
-    """Newton solve for the mean platform offsets X (nDOF,).
-
-    Parameters mirror the reference's solveStatics assembly: constant
-    hydrostatic stiffness + forces (raft_model.py:605-607), constant
-    environment forces (:611-630), pose-dependent mooring (:747).
-
-    step_cap: per-DOF max |dX| per iteration (defaults to the
-    reference's 30 m / 5 m / 0.1 rad caps, raft_model.py:666-667).
-
-    tol: scalar for a fully-converged solve, or the string
-    "reference" to reproduce the reference's stopping semantics
-    (per-DOF tolerances 0.05 m / 0.005 rad, raft_model.py:658-664,
-    with the sub-tolerance Newton step *discarded* — dsolve2 checks
-    convergence before applying the step).  The reference's published
-    equilibria correspond to that rule, so it is the default.
-    """
-    nDOF = fs.nDOF
-    if X0 is None:
-        X0 = jnp.zeros(nDOF)
-    if C_elast is None:
-        C_elast = jnp.zeros((nDOF, nDOF))
-    if step_cap is None:
-        caps = []
-        for dof in fs.reducedDOF:
-            caps.append(30.0 if dof[1] < 2 else 5.0 if dof[1] == 2 else 0.1)
-        step_cap = jnp.asarray(caps)
-    if isinstance(tol, str) and tol == "reference":
-        tols = []
-        for dof in fs.reducedDOF:
-            tols.append(0.05 if dof[1] < 3 else 0.005)
-        tol_vec = jnp.asarray(tols)
-    else:
-        tol_vec = jnp.full(nDOF, tol)
-
-    def net_force(X):
-        F = F_undisplaced - K_hydrostatic @ X + F_env
-        if ms is not None:
-            Fm, _ = mooring_force(ms, X[:6])
-            F = F.at[:6].add(Fm)
-        F = F - C_elast @ X
-        return F
-
-    def step(X):
-        F = net_force(X)
-        K = K_hydrostatic + C_elast
-        if ms is not None:
-            K = K.at[:6, :6].add(mooring_stiffness(ms, X[:6]))
-        dX = jnp.linalg.solve(K, F)
-        return jnp.clip(dX, -step_cap, step_cap)
-
-    def body(carry):
-        X, it, _ = carry
-        dX = step(X)
-        done = jnp.all(jnp.abs(dX) < tol_vec)
-        X = jnp.where(done, X, X + dX)  # sub-tolerance step is discarded
-        return X, it + 1, done
-
-    def cond(carry):
-        _, it, done = carry
-        return (it < max_iter) & (~done)
-
-    X, _, _ = jax.lax.while_loop(cond, body, (X0, 0, jnp.asarray(False)))
-    return X, net_force(X)
+    """Single-FOWT convenience wrapper (original API)."""
+    tol_vec, caps, refs = make_tolerances([fs])
+    if step_cap is not None:
+        caps = step_cap
+    if not (isinstance(tol, str) and tol == "reference"):
+        tol_vec = jnp.full(fs.nDOF, tol)
+    force, stiff = single_ms_closures(ms, fs.nDOF)
+    return solve_equilibrium_general(
+        jnp.asarray(K_hydrostatic), jnp.asarray(F_undisplaced), jnp.asarray(F_env),
+        force, stiff, tol_vec, caps, refs, C_elast=C_elast, X0=X0,
+        max_iter=max_iter,
+    )

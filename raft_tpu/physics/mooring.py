@@ -52,12 +52,15 @@ class MooringSystem:
         return len(self.L)
 
 
-def build_mooring(mooring, rho_water=1025.0, g=9.81):
+def build_mooring(mooring, rho_water=1025.0, g=9.81, x_ref=0.0, y_ref=0.0,
+                  heading_adjust=0.0):
     """Parse the design's ``mooring`` section (MoorPy-compatible schema:
     points / lines / line_types) into a MooringSystem.
 
     Submerged weight per length w = (m' - rho pi/4 d^2) g with d the
-    volume-equivalent diameter (MoorPy convention)."""
+    volume-equivalent diameter (MoorPy convention).  ``x_ref/y_ref`` and
+    ``heading_adjust`` transform the whole system to the FOWT's array
+    position (raft_fowt.py:367 ms.transform)."""
     depth = float(coerce(mooring, "water_depth", default=600.0))
     types = {lt["name"]: lt for lt in mooring["line_types"]}
     points = {p["name"]: p for p in mooring["points"]}
@@ -80,6 +83,16 @@ def build_mooring(mooring, rho_water=1025.0, g=9.81):
         w.append((m_lin - rho_water * np.pi / 4 * d**2) * g)
         EA.append(float(lt["stiffness"]))
 
+    r_anchor = np.array(r_anchor)
+    r_fair = np.array(r_fair)
+    if heading_adjust != 0.0:
+        c, s = np.cos(np.deg2rad(heading_adjust)), np.sin(np.deg2rad(heading_adjust))
+        Rz = np.array([[c, -s, 0.0], [s, c, 0.0], [0.0, 0.0, 1.0]])
+        r_anchor = r_anchor @ Rz.T
+        r_fair = r_fair @ Rz.T
+    r_anchor = r_anchor + np.array([x_ref, y_ref, 0.0])
+    # fairleads stay body-local (the body pose carries x_ref/y_ref)
+
     return MooringSystem(
         r_anchor=np.array(r_anchor),
         r_fair0=np.array(r_fair),
@@ -92,15 +105,17 @@ def build_mooring(mooring, rho_water=1025.0, g=9.81):
 
 # --------------------------------------------------------------- catenary
 
-def _profile(HF, VF, L, w, EA):
+def _profile(HF, VF, L, w, EA, can_ground=True):
     """Horizontal/vertical fairlead-anchor spans (XF, ZF) of an elastic
     catenary with fairlead loads (HF, VF); flat frictionless seabed.
 
-    Grounded when VF < w L (part of the line rests on the seabed)."""
+    Grounded when VF < w L and the lower end rests on the seabed
+    (``can_ground`` — True for anchor lines, False for suspended /
+    shared lines between floating bodies)."""
     HF = jnp.maximum(HF, 1e-8)
     t1 = VF / HF
     s1 = jnp.sqrt(1.0 + t1 * t1)
-    asinh1 = jnp.log(t1 + s1)
+    asinh1 = jnp.arcsinh(t1)  # stable for negative arguments
 
     # grounded regime
     LB = L - VF / w
@@ -111,15 +126,15 @@ def _profile(HF, VF, L, w, EA):
     VA = VF - w * L
     t2 = VA / HF
     s2 = jnp.sqrt(1.0 + t2 * t2)
-    asinh2 = jnp.log(t2 + s2)
+    asinh2 = jnp.arcsinh(t2)
     XF_s = (HF / w) * (asinh1 - asinh2) + HF * L / EA
     ZF_s = (HF / w) * (s1 - s2) + (VF * L - 0.5 * w * L**2) / EA
 
-    grounded = VF < w * L
+    grounded = (VF < w * L) & can_ground
     return jnp.where(grounded, XF_g, XF_s), jnp.where(grounded, ZF_g, ZF_s)
 
 
-def solve_catenary(XF, ZF, L, w, EA, n_iter=60):
+def solve_catenary(XF, ZF, L, w, EA, n_iter=60, can_ground=True):
     """Solve (HF, VF) such that the catenary spans (XF, ZF).
 
     Damped Newton with the MoorPy-style initial guess; fixed iteration
@@ -128,38 +143,51 @@ def solve_catenary(XF, ZF, L, w, EA, n_iter=60):
     XF = jnp.maximum(XF, 1e-6)
     lr = jnp.sqrt(XF**2 + ZF**2)
     taut = L <= lr
+    # slack seed: MoorPy-style sag parameter; taut seed: elastic estimate
     arg = jnp.maximum(3.0 * ((L**2 - ZF**2) / XF**2 - 1.0), 1e-12)
-    lam = jnp.where(taut, 0.2, jnp.sqrt(arg))
-    HF = jnp.maximum(jnp.abs(0.5 * w * XF / lam), 1e-3)
-    VF = 0.5 * w * (ZF / jnp.tanh(lam) + L)
+    lam = jnp.sqrt(arg)
+    HF_slack = jnp.maximum(jnp.abs(0.5 * w * XF / lam), 1e-3)
+    VF_slack = 0.5 * w * (ZF / jnp.tanh(lam) + L)
+    T0 = jnp.maximum(EA * (lr - L) / L, w * L)
+    HF_taut = T0 * XF / lr
+    VF_taut = T0 * ZF / lr + 0.5 * w * L
+    HF = jnp.where(taut, HF_taut, HF_slack)
+    VF = jnp.where(taut, VF_taut, VF_slack)
+
+    def res(hv):
+        x, z = _profile(hv[0], hv[1], L, w, EA, can_ground=can_ground)
+        return jnp.stack([x - XF, z - ZF])
 
     def body(carry, _):
         HF, VF = carry
-
-        def res(hv):
-            x, z = _profile(hv[0], hv[1], L, w, EA)
-            return jnp.stack([x - XF, z - ZF])
-
         hv = jnp.stack([HF, VF])
         r = res(hv)
         J = jax.jacfwd(res)(hv)
-        # guarded 2x2 solve
         det = J[0, 0] * J[1, 1] - J[0, 1] * J[1, 0]
         det = jnp.where(jnp.abs(det) < 1e-30, 1e-30, det)
         dH = -(r[0] * J[1, 1] - r[1] * J[0, 1]) / det
         dV = -(J[0, 0] * r[1] - J[1, 0] * r[0]) / det
-        # damp: cap the step to a fraction of current magnitude scale
-        scale = jnp.maximum(jnp.abs(HF) + jnp.abs(VF), 1.0)
-        cap = 0.5 * scale
-        dH = jnp.clip(dH, -cap, cap)
-        dV = jnp.clip(dV, -cap, cap)
-        HF2 = jnp.maximum(HF + dH, 1e-6)
-        VF2 = VF + dV
-        return (HF2, VF2), None
+        # backtracking: halve the step until the residual norm decreases
+        rn0 = jnp.linalg.norm(r)
+
+        def try_step(alpha):
+            hv2 = jnp.stack([jnp.maximum(HF + alpha * dH, 1e-6), VF + alpha * dV])
+            return jnp.linalg.norm(res(hv2))
+
+        alpha = jnp.asarray(1.0)
+        for _ in range(4):
+            worse = try_step(alpha) > rn0
+            alpha = jnp.where(worse, 0.5 * alpha, alpha)
+        HF2 = jnp.maximum(HF + alpha * dH, 1e-6)
+        VF2 = VF + alpha * dV
+        # reject non-finite steps outright
+        ok = jnp.isfinite(HF2) & jnp.isfinite(VF2)
+        return (jnp.where(ok, HF2, HF), jnp.where(ok, VF2, VF)), None
 
     (HF, VF), _ = jax.lax.scan(body, (HF, VF), None, length=n_iter)
     HA = HF  # no seabed friction
-    VA = jnp.maximum(VF - w * L, 0.0)
+    grounded = (VF < w * L) & can_ground
+    VA = jnp.where(grounded, 0.0, VF - w * L)
     return HF, VF, HA, VA
 
 
@@ -197,3 +225,248 @@ def mooring_tensions(ms: MooringSystem, r6):
     T_fair = jnp.sqrt(info["HF"] ** 2 + info["VF"] ** 2)
     T_anch = jnp.sqrt(info["HA"] ** 2 + info["VA"] ** 2)
     return T_fair, T_anch
+
+
+# ------------------------------------------------------------- networks
+
+class MooringNetwork:
+    """General quasi-static mooring network: lines between fixed
+    anchors, body-attached fairleads and *free* points (e.g. mid-line
+    clump weights in shared-mooring farms).
+
+    Equivalent of an array-level MoorPy system loaded from a MoorDyn
+    file (raft_model.py:84-106).  Free-point equilibrium is an inner
+    damped-Newton solve (MoorPy's solveEquilibrium analog) and the
+    coupled force on each body is a pure function of all body poses, so
+    stiffness blocks (including body-body coupling through shared
+    lines) come from ``jax.jacfwd``.
+    """
+
+    def __init__(self, depth, g=9.81, rho=1025.0):
+        self.depth = float(depth)
+        self.g = g
+        self.rho = rho
+        # points
+        self.p_kind = []     # 0 fixed, 1 body-attached, 2 free
+        self.p_body = []     # body index for kind 1
+        self.p_r = []        # fixed/initial position or body-local position
+        self.p_mass = []
+        self.p_vol = []
+        # lines
+        self.l_ends = []     # (ptA, ptB)
+        self.l_L = []
+        self.l_w = []
+        self.l_EA = []
+
+    # ------------------------------------------------------------ build
+    def add_point(self, kind, r, body=-1, mass=0.0, vol=0.0):
+        self.p_kind.append(kind)
+        self.p_body.append(body)
+        self.p_r.append(np.asarray(r, dtype=float))
+        self.p_mass.append(mass)
+        self.p_vol.append(vol)
+        return len(self.p_kind) - 1
+
+    def add_line(self, pA, pB, L, w, EA):
+        self.l_ends.append((pA, pB))
+        self.l_L.append(L)
+        self.l_w.append(w)
+        self.l_EA.append(EA)
+
+    def finalize(self):
+        self.p_kind = np.asarray(self.p_kind)
+        self.p_body = np.asarray(self.p_body)
+        self.p_r = np.asarray(self.p_r)
+        self.p_mass = np.asarray(self.p_mass)
+        self.p_vol = np.asarray(self.p_vol)
+        self.free_idx = np.where(self.p_kind == 2)[0]
+        self.n_bodies = int(self.p_body.max()) + 1 if len(self.p_body) else 0
+        # a line end can rest on the seabed only if its lower end is a
+        # fixed point at the seabed
+        self.l_can_ground = []
+        for (a, b) in self.l_ends:
+            ground = False
+            for p in (a, b):
+                if self.p_kind[p] == 0 and self.p_r[p][2] <= -self.depth + 1.0:
+                    ground = True
+            self.l_can_ground.append(ground)
+        self.l_can_ground = np.asarray(self.l_can_ground)
+        return self
+
+    # ---------------------------------------------------------- physics
+    def _point_positions(self, r6_bodies, r_free):
+        """Positions of all points given body poses and free positions."""
+        pos = []
+        i_free = 0
+        for i in range(len(self.p_kind)):
+            k = self.p_kind[i]
+            if k == 0:
+                pos.append(jnp.asarray(self.p_r[i]))
+            elif k == 1:
+                r6 = r6_bodies[self.p_body[i]]
+                R = tf.rotation_matrix(r6[3], r6[4], r6[5])
+                pos.append(r6[:3] + R @ jnp.asarray(self.p_r[i]))
+            else:
+                pos.append(r_free[i_free])
+                i_free += 1
+        return jnp.stack(pos)
+
+    def _line_end_forces(self, pos):
+        """Per-line forces on end A and end B attachments.
+
+        Each line is canonicalised with the lower end as the catenary
+        'anchor' side.  Returns (FA (nL,3), FB (nL,3), HF, VF, HA, VA)
+        with A/B in the line's stored order."""
+        FA, FB, tens = [], [], []
+        for il, (a, b) in enumerate(self.l_ends):
+            ra, rb = pos[a], pos[b]
+            flip = ra[2] > rb[2]
+            rlo = jnp.where(flip, rb, ra)
+            rhi = jnp.where(flip, ra, rb)
+            dvec = rhi - rlo
+            XF = jnp.sqrt(dvec[0] ** 2 + dvec[1] ** 2)
+            ZF = dvec[2]
+            XF_safe = jnp.maximum(XF, 1e-8)
+            uh = dvec[:2] / XF_safe
+            HF, VF, HA, VA = solve_catenary(
+                XF, ZF, self.l_L[il], self.l_w[il], self.l_EA[il],
+                can_ground=bool(self.l_can_ground[il]),
+            )
+            F_hi = jnp.concatenate([-HF * uh, jnp.asarray([-VF])])
+            F_lo = jnp.concatenate([HF * uh, jnp.asarray([VA])])
+            Fa = jnp.where(flip, F_hi, F_lo)
+            Fb = jnp.where(flip, F_lo, F_hi)
+            FA.append(Fa)
+            FB.append(Fb)
+            tens.append(jnp.stack([jnp.hypot(HA, VA), jnp.hypot(HF, VF)]))
+        return jnp.stack(FA), jnp.stack(FB), jnp.stack(tens)
+
+    def _free_net_force(self, r6_bodies, r_free):
+        pos = self._point_positions(r6_bodies, r_free)
+        FA, FB, _ = self._line_end_forces(pos)
+        F = jnp.zeros((len(self.free_idx), 3))
+        for il, (a, b) in enumerate(self.l_ends):
+            for p, Fp in ((a, FA[il]), (b, FB[il])):
+                if self.p_kind[p] == 2:
+                    slot = int(np.where(self.free_idx == p)[0][0])
+                    F = F.at[slot].add(Fp)
+        for s, p in enumerate(self.free_idx):
+            Fz = -self.p_mass[p] * self.g + self.rho * self.g * self.p_vol[p]
+            F = F.at[s, 2].add(Fz)
+        return F
+
+    def solve_free_points(self, r6_bodies, n_iter=25):
+        """Inner equilibrium of free points (damped Newton, fixed count)."""
+        if len(self.free_idx) == 0:
+            return jnp.zeros((0, 3))
+        r0 = jnp.asarray(self.p_r[self.free_idx])
+
+        def body(r_free, _):
+            F = self._free_net_force(r6_bodies, r_free).reshape(-1)
+            J = jax.jacfwd(
+                lambda rf: self._free_net_force(r6_bodies, rf.reshape(-1, 3)).reshape(-1)
+            )(r_free.reshape(-1))
+            dX = jnp.linalg.solve(
+                J - 1e-6 * jnp.eye(J.shape[0]), -F
+            )
+            dX = jnp.clip(dX, -50.0, 50.0)
+            return (r_free.reshape(-1) + dX).reshape(-1, 3), None
+
+        r_free, _ = jax.lax.scan(body, r0, None, length=n_iter)
+        return r_free
+
+    def body_forces(self, r6_all):
+        """Net 6-DOF mooring force on every body.
+
+        r6_all : (n_bodies, 6) poses.  Returns (F (n_bodies, 6), info).
+        """
+        r6_all = jnp.asarray(r6_all).reshape(-1, 6)
+        r_free = self.solve_free_points(r6_all)
+        pos = self._point_positions(r6_all, r_free)
+        FA, FB, tens = self._line_end_forces(pos)
+        F = jnp.zeros((r6_all.shape[0], 6))
+        for il, (a, b) in enumerate(self.l_ends):
+            for p, Fp in ((a, FA[il]), (b, FB[il])):
+                if self.p_kind[p] == 1:
+                    bi = int(self.p_body[p])
+                    lever = pos[p] - r6_all[bi, :3]
+                    F = F.at[bi, :3].add(Fp)
+                    F = F.at[bi, 3:].add(jnp.cross(lever, Fp))
+        return F, dict(tensions=tens, r_free=r_free)
+
+    def stiffness(self, r6_all):
+        """Full coupled stiffness (6 n_bodies x 6 n_bodies): exact
+        Jacobian -dF/dX through the free-point equilibrium."""
+
+        def f(x):
+            return self.body_forces(x.reshape(-1, 6))[0].reshape(-1)
+
+        return -jax.jacfwd(f)(jnp.asarray(r6_all).reshape(-1))
+
+
+def parse_moordyn(path, depth, rho=1025.0, g=9.81):
+    """Parse a MoorDyn v1/v2 input file into a MooringNetwork.
+
+    Supports LINE TYPES / POINTS / LINES sections with Fixed, Free,
+    Vessel, Coupled, Turbine<N> and Body<N> attachments (the subset the
+    reference consumes through MoorPy's System.load,
+    raft_model.py:98-100)."""
+    net = MooringNetwork(depth, g=g, rho=rho)
+    types = {}
+    section = None
+    point_ids = {}
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line:
+                continue
+            up = line.upper()
+            if up.startswith("---"):
+                if "LINE TYPE" in up:
+                    section = "types"
+                elif "POINT" in up or "CONNECTION" in up:
+                    section = "points"
+                elif up.startswith("---------------------- LINES") or "- LINES -" in up or up.strip("- ").startswith("LINES"):
+                    section = "lines"
+                else:
+                    section = None
+                continue
+            toks = line.split()
+            if section == "types" and len(toks) >= 4 and toks[0] not in ("Name", "TypeName", "(-)", "(name)"):
+                try:
+                    d = float(toks[1])
+                except ValueError:
+                    continue
+                m = float(toks[2])
+                EA = float(toks[3])
+                types[toks[0]] = dict(w=(m - rho * np.pi / 4 * d**2) * g, EA=EA)
+            elif section == "points" and len(toks) >= 5:
+                try:
+                    pid = int(toks[0])
+                except ValueError:
+                    continue
+                att = toks[1].lower()
+                r = np.array([float(toks[2]), float(toks[3]), float(toks[4])])
+                mass = float(toks[5]) if len(toks) > 5 else 0.0
+                vol = float(toks[6]) if len(toks) > 6 else 0.0
+                if att.startswith("fix") or att.startswith("anch"):
+                    point_ids[pid] = net.add_point(0, r)
+                elif att.startswith("free") or att.startswith("connect"):
+                    point_ids[pid] = net.add_point(2, r, mass=mass, vol=vol)
+                else:
+                    # Vessel / Coupled / Turbine<N> / Body<N>
+                    body = 0
+                    digits = "".join(ch for ch in att if ch.isdigit())
+                    if digits:
+                        body = int(digits) - 1
+                    point_ids[pid] = net.add_point(1, r, body=body)
+            elif section == "lines" and len(toks) >= 5:
+                try:
+                    int(toks[0])
+                except ValueError:
+                    continue
+                lt = types[toks[1]]
+                a = point_ids[int(toks[2])]
+                b = point_ids[int(toks[3])]
+                net.add_line(a, b, float(toks[4]), lt["w"], lt["EA"])
+    return net.finalize()
