@@ -1,0 +1,218 @@
+// raft_tpu native panel-method kernel.
+//
+// First-order potential-flow boundary-element solver core: constant-
+// strength source panels (Hess & Smith) with a flat free surface
+// handled by the method of images.  This is the native-code foundation
+// of the HAMS-equivalent solver the reference delegates to an external
+// Fortran package (pyHAMS; /root/reference/raft/raft_fowt.py:1288-1442)
+// — here the influence-matrix assembly and dense solve live in C++
+// behind a C ABI consumed through ctypes.
+//
+// Current scope: frequency-limit radiation problems.
+//   mirror = -1 : high-frequency free-surface condition (phi = 0 on
+//                 z = 0, negative image)  -> A(w -> inf)
+//   mirror = +1 : rigid-lid condition (dphi/dz = 0, positive image)
+//                 -> A(w -> 0)
+// The finite-frequency wave Green function slots into the same
+// assembly (influence() below) as a follow-up.
+//
+// Numerics: panel integrals by centroid collocation with 2x2 Gauss
+// refinement for near-field pairs and an analytic equivalent-disk self
+// term; dense partial-pivot LU for the source strengths.
+
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct V3 {
+  double x, y, z;
+};
+
+inline V3 sub(const V3& a, const V3& b) { return {a.x - b.x, a.y - b.y, a.z - b.z}; }
+inline double dot(const V3& a, const V3& b) { return a.x * b.x + a.y * b.y + a.z * b.z; }
+inline double norm(const V3& a) { return std::sqrt(dot(a, a)); }
+
+// potential at p of a unit-strength source distribution (1/(4 pi r)
+// kernel) over a quad panel given by 4 vertices, by Gauss quadrature
+double quad_potential(const V3* verts, double area, const V3& p) {
+  // bilinear map with 2x2 Gauss points
+  static const double gp[2] = {-0.5773502691896257, 0.5773502691896257};
+  double phi = 0.0;
+  for (int iu = 0; iu < 2; ++iu) {
+    for (int iv = 0; iv < 2; ++iv) {
+      double u = 0.5 * (1 + gp[iu]);
+      double v = 0.5 * (1 + gp[iv]);
+      V3 q{
+          (1 - u) * (1 - v) * verts[0].x + u * (1 - v) * verts[1].x +
+              u * v * verts[2].x + (1 - u) * v * verts[3].x,
+          (1 - u) * (1 - v) * verts[0].y + u * (1 - v) * verts[1].y +
+              u * v * verts[2].y + (1 - u) * v * verts[3].y,
+          (1 - u) * (1 - v) * verts[0].z + u * (1 - v) * verts[1].z +
+              u * v * verts[2].z + (1 - u) * v * verts[3].z,
+      };
+      double r = norm(sub(p, q));
+      phi += 0.25 * area / (4.0 * M_PI * (r > 1e-12 ? r : 1e-12));
+    }
+  }
+  return phi;
+}
+
+// velocity (gradient of potential) at p from a quad source panel
+V3 quad_velocity(const V3* verts, double area, const V3& p) {
+  static const double gp[2] = {-0.5773502691896257, 0.5773502691896257};
+  V3 vel{0, 0, 0};
+  for (int iu = 0; iu < 2; ++iu) {
+    for (int iv = 0; iv < 2; ++iv) {
+      double u = 0.5 * (1 + gp[iu]);
+      double v = 0.5 * (1 + gp[iv]);
+      V3 q{
+          (1 - u) * (1 - v) * verts[0].x + u * (1 - v) * verts[1].x +
+              u * v * verts[2].x + (1 - u) * v * verts[3].x,
+          (1 - u) * (1 - v) * verts[0].y + u * (1 - v) * verts[1].y +
+              u * v * verts[2].y + (1 - u) * v * verts[3].y,
+          (1 - u) * (1 - v) * verts[0].z + u * (1 - v) * verts[1].z +
+              u * v * verts[2].z + (1 - u) * v * verts[3].z,
+      };
+      V3 d = sub(p, q);
+      double r = norm(d);
+      double r3 = (r > 1e-9 ? r * r * r : 1e-27);
+      double c = 0.25 * area / (4.0 * M_PI * r3);
+      vel.x += c * d.x;
+      vel.y += c * d.y;
+      vel.z += c * d.z;
+    }
+  }
+  return vel;
+}
+
+// dense partial-pivot LU solve: A (n x n, row major) x = b, overwrites
+int lu_solve(std::vector<double>& A, std::vector<double>& b, int n) {
+  std::vector<int> piv(n);
+  for (int i = 0; i < n; ++i) piv[i] = i;
+  for (int k = 0; k < n; ++k) {
+    int pk = k;
+    double amax = std::fabs(A[k * n + k]);
+    for (int i = k + 1; i < n; ++i) {
+      double a = std::fabs(A[i * n + k]);
+      if (a > amax) {
+        amax = a;
+        pk = i;
+      }
+    }
+    if (amax < 1e-30) return 1;
+    if (pk != k) {
+      for (int j = 0; j < n; ++j) std::swap(A[k * n + j], A[pk * n + j]);
+      std::swap(b[k], b[pk]);
+    }
+    double inv = 1.0 / A[k * n + k];
+    for (int i = k + 1; i < n; ++i) {
+      double f = A[i * n + k] * inv;
+      if (f == 0.0) continue;
+      A[i * n + k] = f;
+      for (int j = k + 1; j < n; ++j) A[i * n + j] -= f * A[k * n + j];
+      b[i] -= f * b[k];
+    }
+  }
+  for (int i = n - 1; i >= 0; --i) {
+    double s = b[i];
+    for (int j = i + 1; j < n; ++j) s -= A[i * n + j] * b[j];
+    b[i] = s / A[i * n + i];
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Solve the radiation problem for all 6 rigid-body modes.
+//
+// vertices : (n, 4, 3) panel corner coordinates (below the waterline)
+// centroid : (n, 3); normal : (n, 3) body-outward unit normals;
+// area     : (n,)
+// mirror   : -1 (phi=0 free surface, w->inf) or +1 (rigid lid, w->0)
+// rho      : fluid density
+// ref      : (3,) reference point for the rotational modes
+// A_out    : (6, 6) added-mass matrix, row major
+//
+// Returns 0 on success.
+int panel_radiation_added_mass(int n, const double* vertices,
+                               const double* centroid, const double* normal,
+                               const double* area, int mirror, double rho,
+                               const double* ref, double* A_out) {
+  const V3* verts = reinterpret_cast<const V3*>(vertices);
+  const V3* cen = reinterpret_cast<const V3*>(centroid);
+  const V3* nor = reinterpret_cast<const V3*>(normal);
+  const V3 r0{ref[0], ref[1], ref[2]};
+
+  // ---- influence matrix: normal velocity at panel i from unit source
+  // on panel j (+ mirrored image panel)
+  std::vector<double> G(static_cast<size_t>(n) * n);
+  std::vector<double> P(static_cast<size_t>(n) * n);  // potentials
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j < n; ++j) {
+      if (i == j) {
+        // analytic self terms: half-space velocity jump + equivalent disk
+        G[i * n + j] = 0.5;  // (sigma/2) outward normal velocity
+        double a_eq = std::sqrt(area[j] / M_PI);
+        P[i * n + j] = 0.5 * a_eq;  // disk potential a/2 for 1/(4 pi r)
+      } else {
+        V3 vel = quad_velocity(&verts[4 * j], area[j], cen[i]);
+        G[i * n + j] = dot(vel, nor[i]);
+        P[i * n + j] = quad_potential(&verts[4 * j], area[j], cen[i]);
+      }
+      // mirrored image above z = 0
+      V3 iv[4];
+      for (int k = 0; k < 4; ++k) {
+        iv[k] = verts[4 * j + k];
+        iv[k].z = -iv[k].z;
+      }
+      V3 velm = quad_velocity(iv, area[j], cen[i]);
+      double phim = quad_potential(iv, area[j], cen[i]);
+      G[i * n + j] += mirror * dot(velm, nor[i]);
+      P[i * n + j] += mirror * phim;
+    }
+  }
+
+  // ---- modes: rigid-body normal velocities
+  // translations: n_k ; rotations: ((r - r0) x n)_k
+  std::vector<double> phi(static_cast<size_t>(6) * n);  // panel potentials per mode
+  std::vector<double> nmode(static_cast<size_t>(6) * n);
+  for (int i = 0; i < n; ++i) {
+    V3 rr = sub(cen[i], r0);
+    double nm[6] = {nor[i].x,
+                    nor[i].y,
+                    nor[i].z,
+                    rr.y * nor[i].z - rr.z * nor[i].y,
+                    rr.z * nor[i].x - rr.x * nor[i].z,
+                    rr.x * nor[i].y - rr.y * nor[i].x};
+    for (int m = 0; m < 6; ++m) nmode[m * n + i] = nm[m];
+  }
+
+  for (int m = 0; m < 6; ++m) {
+    std::vector<double> Gc(G);  // LU destroys the matrix
+    std::vector<double> rhs(nmode.begin() + m * n, nmode.begin() + (m + 1) * n);
+    if (lu_solve(Gc, rhs, n)) return 1;
+    // potentials phi_m(i) = sum_j P(i,j) sigma_j
+    for (int i = 0; i < n; ++i) {
+      double s = 0.0;
+      for (int j = 0; j < n; ++j) s += P[i * n + j] * rhs[j];
+      phi[m * n + i] = s;
+    }
+  }
+
+  // ---- added mass A_km = rho * sum_i phi_m(i) n_k(i) dS_i
+  for (int k = 0; k < 6; ++k) {
+    for (int m = 0; m < 6; ++m) {
+      double s = 0.0;
+      for (int i = 0; i < n; ++i) s += phi[m * n + i] * nmode[k * n + i] * area[i];
+      A_out[k * 6 + m] = rho * s;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,133 @@
+"""Panel mesh generation for circular members (member2pnl equivalent).
+
+Generates quadrilateral panel meshes of the submerged portion of
+cylindrical members for the potential-flow solver, mirroring the role
+of the reference mesher (``/root/reference/raft/member2pnl.py``:
+``meshMember`` :73, side/cap paneling with waterline clipping) with a
+simpler regular discretisation, plus a writer for the HAMS ``.pnl``
+interchange format the reference's BEM pipeline consumes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def mesh_cylinder(stations, diameters, rA, q, n_az=18, dz_max=2.0):
+    """Quad panel mesh of a (possibly tapered) circular member's wetted
+    surface, clipped at z = 0; includes a bottom cap.
+
+    stations : (n,) axial positions from end A; diameters : (n,);
+    rA : (3,) end-A coordinates; q : (3,) axial unit vector.
+
+    Returns (vertices (P,4,3), centroids (P,3), normals (P,3) outward,
+    areas (P,)).
+    """
+    stations = np.asarray(stations, dtype=float)
+    diameters = np.asarray(diameters, dtype=float)
+    rA = np.asarray(rA, dtype=float)
+    q = np.asarray(q, dtype=float)
+    q = q / np.linalg.norm(q)
+
+    # axial subdivision (finer than stations)
+    s_grid = [stations[0]]
+    for i in range(1, len(stations)):
+        seg = stations[i] - stations[i - 1]
+        if seg <= 0:
+            continue
+        nseg = max(1, int(np.ceil(seg / dz_max)))
+        s_grid += list(stations[i - 1] + seg * (np.arange(1, nseg + 1) / nseg))
+    s_grid = np.asarray(s_grid)
+    d_grid = np.interp(s_grid, stations, diameters)
+
+    # local transverse axes
+    tmp = np.array([1.0, 0, 0]) if abs(q[2]) > 0.9 else np.array([0, 0, 1.0])
+    p1 = np.cross(tmp, q)
+    p1 /= np.linalg.norm(p1)
+    p2 = np.cross(q, p1)
+
+    th = np.linspace(0, 2 * np.pi, n_az + 1)
+    verts, cents, norms, areas = [], [], [], []
+
+    def ring(s, d):
+        c = rA + q * s
+        return c[None, :] + 0.5 * d * (
+            np.cos(th)[:, None] * p1[None, :] + np.sin(th)[:, None] * p2[None, :]
+        )
+
+    for i in range(len(s_grid) - 1):
+        zA = rA[2] + q[2] * s_grid[i]
+        zB = rA[2] + q[2] * s_grid[i + 1]
+        if zA >= 0 and zB >= 0:
+            continue
+        sA, dA = s_grid[i], d_grid[i]
+        sB, dB = s_grid[i + 1], d_grid[i + 1]
+        # clip the segment at the waterline
+        if zB > 0:
+            f = (0.0 - zA) / (zB - zA)
+            sB = sA + f * (s_grid[i + 1] - s_grid[i])
+            dB = dA + f * (d_grid[i + 1] - d_grid[i])
+        elif zA > 0:
+            f = (0.0 - zB) / (zA - zB)
+            sA = sB + f * (s_grid[i] - s_grid[i + 1])
+            dA = dB + f * (d_grid[i] - d_grid[i + 1])
+        rA_ring = ring(sA, dA)
+        rB_ring = ring(sB, dB)
+        for k in range(n_az):
+            vs = np.array([rA_ring[k], rA_ring[k + 1], rB_ring[k + 1], rB_ring[k]])
+            c = vs.mean(axis=0)
+            d1 = vs[2] - vs[0]
+            d2 = vs[3] - vs[1]
+            nvec = np.cross(d1, d2)
+            a = 0.5 * np.linalg.norm(nvec)
+            if a < 1e-10:
+                continue
+            nvec = nvec / (2 * a)
+            # outward = away from member axis
+            axis_pt = rA + q * np.dot(c - rA, q)
+            if np.dot(nvec, c - axis_pt) < 0:
+                nvec = -nvec
+                vs = vs[::-1]
+            verts.append(vs)
+            cents.append(c)
+            norms.append(nvec)
+            areas.append(a)
+
+    # bottom cap (triangle fan collapsed to quads), if submerged
+    if rA[2] + q[2] * s_grid[0] < 0:
+        d0 = d_grid[0]
+        c0 = rA + q * s_grid[0]
+        ring0 = ring(s_grid[0], d0)
+        for k in range(n_az):
+            vs = np.array([c0, ring0[k + 1], ring0[k], c0])
+            d1 = vs[2] - vs[0]
+            d2 = vs[1] - vs[0]
+            nvec = np.cross(d1, d2)
+            a = 0.5 * np.linalg.norm(nvec)
+            if a < 1e-10:
+                continue
+            nvec = nvec / (2 * a)
+            if np.dot(nvec, -q) < 0:  # cap normal points away from body (down)
+                nvec = -nvec
+                vs = vs[::-1]
+            verts.append(vs)
+            cents.append(vs.mean(axis=0))
+            norms.append(nvec)
+            areas.append(a)
+
+    return (np.asarray(verts), np.asarray(cents), np.asarray(norms),
+            np.asarray(areas))
+
+
+def write_pnl(path, vertices, title="raft_tpu panel mesh"):
+    """Write panels in the HAMS .pnl format (member2pnl.writeMesh:280)."""
+    n = len(vertices)
+    with open(path, "w") as f:
+        f.write(f"    --------------{title}-----------------\n")
+        f.write("    Output the particulars of the panel mesh\n")
+        f.write(f"    {n}    0    1    1\n\n")
+        for i, quad in enumerate(vertices):
+            f.write(f"    {i+1}  4 ")
+            for v in quad:
+                f.write(f"  {v[0]:.6e} {v[1]:.6e} {v[2]:.6e}")
+            f.write("\n")

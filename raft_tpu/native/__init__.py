@@ -1,0 +1,70 @@
+"""ctypes bindings for the native (C++) kernels.
+
+The shared library is built from ``native/panel_bem.cpp`` (CMake or a
+one-line g++ invocation); if no prebuilt ``.so`` is found next to the
+sources it is compiled on first use.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "native", "panel_bem.cpp")
+_LIB = os.path.join(_REPO, "native", "libpanel_bem.so")
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        subprocess.check_call(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC]
+        )
+    _lib = ctypes.CDLL(_LIB)
+    _lib.panel_radiation_added_mass.restype = ctypes.c_int
+    _lib.panel_radiation_added_mass.argtypes = [
+        ctypes.c_int,
+        np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS"),
+        ctypes.c_int,
+        ctypes.c_double,
+        np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS"),
+    ]
+    return _lib
+
+
+def radiation_added_mass(vertices, centroids, normals, areas, mirror=-1,
+                         rho=1025.0, ref=(0.0, 0.0, 0.0)):
+    """6x6 frequency-limit added-mass matrix from the native panel solver.
+
+    mirror = -1 : high-frequency free surface (phi = 0) -> A(w->inf)
+    mirror = +1 : rigid lid -> A(w->0)
+    """
+    lib = _load()
+    n = len(areas)
+    A = np.zeros(36)
+    rc = lib.panel_radiation_added_mass(
+        n,
+        np.ascontiguousarray(vertices, dtype=np.float64).reshape(-1),
+        np.ascontiguousarray(centroids, dtype=np.float64).reshape(-1),
+        np.ascontiguousarray(normals, dtype=np.float64).reshape(-1),
+        np.ascontiguousarray(areas, dtype=np.float64),
+        int(mirror),
+        float(rho),
+        np.ascontiguousarray(ref, dtype=np.float64),
+        A,
+    )
+    if rc != 0:
+        raise RuntimeError("panel radiation solve failed (singular system)")
+    return A.reshape(6, 6)
