@@ -1,0 +1,25 @@
+"""CLI: ``python -m raft_tpu design.yaml [--csv out.csv]``."""
+
+import argparse
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="raft_tpu: TPU-native frequency-domain FOWT analysis")
+    p.add_argument("design", help="design YAML (RAFT-compatible schema)")
+    p.add_argument("--csv", default=None, help="write channel statistics CSV")
+    args = p.parse_args()
+
+    from raft_tpu.drivers import run
+
+    model = run(args.design, save_csv=args.csv)
+    for iCase, per_fowt in model.results["case_metrics"].items():
+        for ifowt, m in per_fowt.items():
+            print(f"case {iCase} fowt {ifowt}: "
+                  f"surge {float(m['surge_avg']):+.2f}±{float(m['surge_std']):.2f} m, "
+                  f"heave {float(m['heave_avg']):+.2f}±{float(m['heave_std']):.2f} m, "
+                  f"pitch {float(m['pitch_avg']):+.2f}±{float(m['pitch_std']):.2f} deg")
+
+
+if __name__ == "__main__":
+    main()

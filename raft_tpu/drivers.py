@@ -1,0 +1,77 @@
+"""High-level drivers: the ``runRAFT``-style entry point and utilities.
+
+Equivalents of the reference driver layer (``/root/reference/raft/
+raft_model.py``: ``runRAFT`` :2247-2285, ``saveResponses`` :1400-1462,
+``powerThrustCurve`` :1877-1955) plus a module CLI
+(``python -m raft_tpu design.yaml``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def run(input_file, save_csv=None):
+    """Load a design, analyze all load cases, return the Model.
+
+    runRAFT equivalent: YAML -> Model -> analyze_cases (-> CSV)."""
+    import raft_tpu
+
+    model = raft_tpu.Model(input_file)
+    model.analyze_cases()
+    if save_csv:
+        save_responses(model, save_csv)
+    return model
+
+
+def save_responses(model, path):
+    """Write per-case channel statistics to CSV (saveResponses analog)."""
+    rows = ["case,fowt,channel,avg,std,max,min"]
+    for iCase, per_fowt in model.results["case_metrics"].items():
+        for ifowt, metrics in per_fowt.items():
+            for ch in ("surge", "sway", "heave", "roll", "pitch", "yaw"):
+                rows.append(
+                    f"{iCase},{ifowt},{ch},"
+                    f"{float(metrics[ch + '_avg']):.6e},"
+                    f"{float(metrics[ch + '_std']):.6e},"
+                    f"{float(metrics[ch + '_max']):.6e},"
+                    f"{float(metrics[ch + '_min']):.6e}"
+                )
+            if "Tmoor_avg" in metrics:
+                T = np.asarray(metrics["Tmoor_avg"])
+                Ts = np.asarray(metrics["Tmoor_std"])
+                for iT in range(len(T)):
+                    rows.append(
+                        f"{iCase},{ifowt},Tmoor{iT},"
+                        f"{T[iT]:.6e},{Ts[iT]:.6e},"
+                        f"{T[iT] + 3 * Ts[iT]:.6e},{T[iT] - 3 * Ts[iT]:.6e}"
+                    )
+    with open(path, "w") as f:
+        f.write("\n".join(rows) + "\n")
+
+
+def power_thrust_curve(model, speeds, ifowt=0, ir=0):
+    """Steady power/thrust curve over wind speeds via the jax BEMT
+    (powerThrustCurve equivalent) — one vmapped rotor evaluation.
+
+    Returns dict(speeds, thrust [N], torque [Nm], power [W],
+    Omega_rpm, pitch_deg)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.physics.aero import operating_point, rotor_loads
+
+    rot = model.rotor_aero[ir]
+    rprops = model.fowtList[ifowt].rotors[ir]
+    tilt = -np.arctan2(rprops.q_rel[2], np.hypot(rprops.q_rel[0], rprops.q_rel[1]))
+
+    def one(U):
+        Om, pit = operating_point(rot, U)
+        loads = rotor_loads(rot, U, Om, pit, tilt, 0.0)
+        return loads[0], loads[3], loads[3] * Om * jnp.pi / 30.0, Om, pit
+
+    T, Q, P, Om, pit = jax.vmap(one)(jnp.asarray(speeds, dtype=float))
+    return dict(
+        speeds=np.asarray(speeds), thrust=np.asarray(T), torque=np.asarray(Q),
+        power=np.asarray(P), Omega_rpm=np.asarray(Om), pitch_deg=np.asarray(pit),
+    )

@@ -1,0 +1,67 @@
+"""Driver-layer tests: run(), CSV output, power/thrust curve, IEC wind."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import ref_data
+
+
+def test_run_and_csv(tmp_path):
+    from raft_tpu.drivers import run
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "raft_tpu", "designs", "spar_demo.yaml")
+    csv = tmp_path / "out.csv"
+    model = run(path, save_csv=str(csv))
+    assert 0 in model.results["case_metrics"]
+    text = csv.read_text()
+    assert "surge" in text and "Tmoor0" in text
+
+
+def test_power_thrust_curve():
+    import raft_tpu
+    from raft_tpu.drivers import power_thrust_curve
+
+    path = ref_data("OC3spar.yaml")
+    if not os.path.exists(path):
+        pytest.skip("reference data unavailable")
+    model = raft_tpu.Model(path)
+    out = power_thrust_curve(model, np.linspace(5, 20, 6))
+    assert np.all(out["thrust"] > 0)
+    assert np.all(out["power"] > 0)
+    # rated power of the 5MW class machine within a sensible band
+    assert 3e6 < out["power"].max() < 9e6
+
+
+def test_iec_wind_events():
+    from raft_tpu.physics.iec_wind import IECWindExtreme, write_wnd
+
+    iec = IECWindExtreme(turbine_class="I", turbulence_class="B",
+                         z_hub=90.0, D=126.0)
+    assert np.isclose(iec.NTM(10.0), 0.14 * (0.75 * 10 + 5.6))
+    eog = iec.EOG(11.4)
+    # the EOG dips then rises; peak-to-peak bounded by the gust magnitude
+    assert eog["V_gust"].min() < 0 < eog["V_gust"].max()
+    edc = iec.EDC(11.4)
+    assert 0 < edc["theta_e"] <= 180
+    assert np.isclose(edc["theta_pos"][-1], edc["theta_e"])
+    ecd = iec.ECD(11.4)
+    assert np.isclose(ecd["V"][-1] - ecd["V"][0], 15.0)
+    ews = iec.EWS(11.4)
+    assert ews["shear_lin"].max() > 0
+
+
+def test_wnd_writer(tmp_path):
+    from raft_tpu.physics.iec_wind import IECWindExtreme, write_wnd
+
+    iec = IECWindExtreme()
+    eog = iec.EOG(11.4)
+    t = eog["t"]
+    z = np.zeros_like(t)
+    p = tmp_path / "eog.wnd"
+    write_wnd(p, (t, eog["V"], z, z, z, z + 0.2, z, eog["V_gust"], z),
+              header_lines=["! EOG"])
+    assert p.read_text().startswith("! EOG")
