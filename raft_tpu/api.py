@@ -29,6 +29,98 @@ from raft_tpu.physics.statics import calc_statics, node_T, platform_kinematics
 from raft_tpu.ops import waves as wv
 
 
+def make_design_evaluator(model):
+    """Build ``evaluate(params) -> outputs`` with traced *design*
+    parameters — the 10k-design-sweep axis of the north star.
+
+    params (all optional, broadcastable scalars):
+      Hs, Tp, beta       sea state
+      Cd_scale, Ca_scale strip drag / added-mass coefficient multipliers
+      L_moor_scale       mooring unstretched-length multiplier
+
+    Geometry shapes are fixed per design family; the parameters scale
+    the build-time tensors inside the trace, so the whole map is
+    jit/vmap-able over designs AND differentiable (e.g. optimize
+    mooring length against a response metric with ``jax.grad``).
+    """
+    import dataclasses
+
+    fs = model.fowtList[0]
+    ms0 = model.ms
+    fh = model.hydro[0]
+    ss0 = fh.strips
+    w = jnp.asarray(model.w)
+    k = jnp.asarray(model.k)
+    dw = model.w[1] - model.w[0]
+    nw = model.nw
+    nDOF = fs.nDOF
+
+    stat = model.statics()
+    K_h = np.asarray(stat["C_struc"] + stat["C_hydro"])
+    F_und = np.asarray(stat["W_struc"] + stat["W_hydro"] + stat["f0_additional"])
+    M_struc = np.asarray(stat["M_struc"])
+
+    def evaluate(params):
+        Hs = params.get("Hs", 6.0)
+        Tp = params.get("Tp", 12.0)
+        beta = params.get("beta", 0.0)
+        Cd_s = params.get("Cd_scale", 1.0)
+        Ca_s = params.get("Ca_scale", 1.0)
+        L_s = params.get("L_moor_scale", 1.0)
+
+        ss = dataclasses.replace(
+            ss0,
+            Cd_q=jnp.asarray(ss0.Cd_q) * Cd_s,
+            Cd_p1=jnp.asarray(ss0.Cd_p1) * Cd_s,
+            Cd_p2=jnp.asarray(ss0.Cd_p2) * Cd_s,
+            Cd_End=jnp.asarray(ss0.Cd_End) * Cd_s,
+            Ca_q=jnp.asarray(ss0.Ca_q) * Ca_s,
+            Ca_p1=jnp.asarray(ss0.Ca_p1) * Ca_s,
+            Ca_p2=jnp.asarray(ss0.Ca_p2) * Ca_s,
+            Ca_End=jnp.asarray(ss0.Ca_End) * Ca_s,
+            Cm_p1_w=1.0 + Ca_s * (jnp.asarray(ss0.Cm_p1_w) - 1.0),
+            Cm_p2_w=1.0 + Ca_s * (jnp.asarray(ss0.Cm_p2_w) - 1.0),
+        )
+        ms = None
+        if ms0 is not None:
+            ms = dataclasses.replace(ms0, L=jnp.asarray(ms0.L) * L_s)
+
+        # mean offsets
+        X0, _ = solve_equilibrium(fs, ms, K_h, F_und, jnp.zeros(nDOF))
+
+        r_nodes, R_ptfm, r_root = platform_kinematics(fs, X0)
+        Tn = node_T(r_nodes, r_root)
+        # hydro constants recomputed in-trace (coefficients are traced)
+        hc = morison.hydro_constants(fs, ss, R_ptfm, r_nodes, Tn)
+
+        S = wv.jonswap(w, Hs, Tp)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        exc = morison.hydro_excitation(
+            fs, ss, hc, zeta[None, :], jnp.asarray([beta]), w, k, Tn, r_nodes)
+
+        C_moor = jnp.zeros((nDOF, nDOF))
+        if ms is not None:
+            C_moor = C_moor.at[:6, :6].add(mooring_stiffness(ms, X0[:6]))
+        M_lin = jnp.broadcast_to(
+            (jnp.asarray(M_struc) + hc["A_hydro"])[:, :, None], (nDOF, nDOF, nw))
+        B_lin = jnp.zeros((nDOF, nDOF, nw))
+        C_lin = jnp.asarray(K_h) + C_moor
+        F_lin = exc["F_hydro_iner"][0]
+
+        Z, _, Bmat = solve_dynamics_fowt(
+            fs, ss, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
+            w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart)
+        F_wave = exc["F_hydro_iner"][0] + morison.drag_excitation(
+            fs, ss, hc, Bmat, exc["u"][0], Tn, r_nodes)
+        Xi = system_response(Z, F_wave[None])[0]
+        return dict(
+            X0=X0, Xi=Xi, RAO=wv.get_rao(Xi, zeta),
+            PSD=0.5 * jnp.abs(Xi) ** 2 / dw, S=S,
+        )
+
+    return evaluate
+
+
 def make_case_evaluator(model, n_stat_iter=12):
     """Build ``evaluate(Hs, Tp, beta) -> outputs`` for one design.
 

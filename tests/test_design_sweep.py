@@ -1,0 +1,57 @@
+"""Design-axis sweep and differentiability tests.
+
+The north-star use case: batch *design variants* (not just sea states)
+through one compiled program and differentiate response metrics with
+respect to design parameters.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def evaluator():
+    import raft_tpu
+    from raft_tpu.api import make_design_evaluator
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "raft_tpu", "designs", "spar_demo.yaml")
+    model = raft_tpu.Model(path)
+    return make_design_evaluator(model)
+
+
+def test_design_vmap(evaluator):
+    """8 design variants in one vmapped program."""
+    f = jax.jit(jax.vmap(lambda cd: evaluator(
+        {"Hs": 6.0, "Tp": 12.0, "beta": 0.0, "Cd_scale": cd})["PSD"]))
+    cds = jnp.linspace(0.5, 2.0, 8)
+    psd = np.asarray(f(cds))
+    assert psd.shape[0] == 8
+    assert np.isfinite(psd).all()
+    # more drag -> more damping -> smaller resonant response
+    peak = psd[:, 0, :].max(axis=1)
+    assert peak[0] > peak[-1]
+
+
+def test_design_gradient(evaluator):
+    """Exact gradient of a response metric wrt a design parameter."""
+
+    def metric(L_scale):
+        out = evaluator({"Hs": 6.0, "Tp": 12.0, "beta": 0.0,
+                         "L_moor_scale": L_scale})
+        return jnp.sum(out["PSD"][0])  # integrated surge PSD
+
+    # forward-mode (the fixed-point solves are lax.while_loops, which
+    # support jvp but not reverse-mode; one design scalar -> jacfwd)
+    g = jax.jacfwd(metric)(jnp.asarray(1.0))
+    assert np.isfinite(float(g))
+    # check against finite difference
+    eps = 1e-4
+    fd = (float(metric(1.0 + eps)) - float(metric(1.0 - eps))) / (2 * eps)
+    assert abs(float(g) - fd) / (abs(fd) + 1e-9) < 5e-2
