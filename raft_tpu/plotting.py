@@ -1,0 +1,113 @@
+"""Visualisation: system geometry and response spectra.
+
+Equivalent of the reference's plotting layer (``/root/reference/raft/
+raft_model.py``: ``plot`` :1532, ``plot2d`` :1599, ``plotResponses``
+:1363; member/mooring renderers in the component classes).  Matplotlib
+is imported lazily so headless/batch runs never pay for it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def plot_system(model, ax=None, color="k", n_theta=12):
+    """3-D render of members (as surface meshes), mooring lines
+    (catenary profiles) and anchors for every FOWT."""
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        fig = plt.figure(figsize=(9, 7))
+        ax = fig.add_subplot(111, projection="3d")
+
+    for i, fs in enumerate(model.fowtList):
+        off = np.array([fs.x_ref, fs.y_ref, 0.0])
+        for mem in fs.members:
+            if mem.part_of == "nacelle":
+                continue
+            _plot_member(ax, mem, off, color=color, n_theta=n_theta)
+        ms = model.ms_list[i]
+        if ms is not None:
+            for il in range(ms.n_lines):
+                _plot_line(ax, ms.r_anchor[il], off + ms.r_fair0[il],
+                           ms.L[il], ms.w[il], ms.EA[il])
+    if model.ms_array is not None:
+        net = model.ms_array
+        import jax.numpy as jnp
+
+        r6 = np.stack([[f.x_ref, f.y_ref, 0, 0, 0, 0] for f in model.fowtList])
+        _, info = net.body_forces(jnp.asarray(r6, dtype=float))
+        pos = np.asarray(net._point_positions(jnp.asarray(r6, dtype=float),
+                                              info["r_free"]))
+        for (a, b), L, w_l, EA in zip(net.l_ends, net.l_L, net.l_w, net.l_EA):
+            _plot_line(ax, pos[a], pos[b], L, w_l, EA)
+
+    ax.set_xlabel("x [m]")
+    ax.set_ylabel("y [m]")
+    ax.set_zlabel("z [m]")
+    try:
+        ax.set_box_aspect((1, 1, 0.5))
+    except AttributeError:
+        pass
+    return ax
+
+
+def _plot_member(ax, mem, off, color="k", n_theta=12):
+    th = np.linspace(0, 2 * np.pi, n_theta + 1)
+    pts_a, pts_b = [], []
+    for i in range(len(mem.stations)):
+        c = off + mem.rA0 + mem.q0 * mem.stations[i]
+        d = mem.d[i]
+        ring = c[None, :] + 0.5 * d[0] * np.cos(th)[:, None] * mem.p10[None, :] \
+            + 0.5 * d[1] * np.sin(th)[:, None] * mem.p20[None, :]
+        ax.plot(ring[:, 0], ring[:, 1], ring[:, 2], color=color, lw=0.5)
+        pts_a.append(ring)
+    for k in range(0, n_theta + 1, max(1, n_theta // 4)):
+        line = np.stack([r[k] for r in pts_a])
+        ax.plot(line[:, 0], line[:, 1], line[:, 2], color=color, lw=0.5)
+
+
+def _plot_line(ax, rA, rB, L, w_line, EA, n=30):
+    """Catenary profile between two points (for rendering only)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.physics.mooring import solve_catenary, _profile
+
+    lo, hi = (rA, rB) if rA[2] <= rB[2] else (rB, rA)
+    dv = np.asarray(hi) - np.asarray(lo)
+    XF = max(np.hypot(dv[0], dv[1]), 1e-6)
+    uh = dv[:2] / XF
+    HF, VF, _, _ = solve_catenary(jnp.asarray(XF), jnp.asarray(dv[2]),
+                                  jnp.asarray(float(L)), jnp.asarray(float(w_line)),
+                                  jnp.asarray(float(EA)))
+    s = np.linspace(0, float(L), n)
+    xs, zs = [], []
+    for si in s:
+        VFs = float(VF) - float(w_line) * (float(L) - si)
+        x, z = _profile(jnp.asarray(float(HF)), jnp.asarray(max(VFs, 0.0) if VFs < 0 else VFs),
+                        jnp.asarray(si), jnp.asarray(float(w_line)), jnp.asarray(float(EA)))
+        xs.append(float(x))
+        zs.append(float(z))
+    xs = np.clip(np.asarray(xs), 0, XF)
+    zs = np.asarray(zs)
+    pts = np.stack([np.asarray(lo)[0] + uh[0] * xs,
+                    np.asarray(lo)[1] + uh[1] * xs,
+                    np.asarray(lo)[2] + zs], axis=1)
+    ax.plot(pts[:, 0], pts[:, 1], pts[:, 2], color="tab:blue", lw=0.8)
+
+
+def plot_responses(model, channels=("surge", "heave", "pitch"), ifowt=0):
+    """Response PSDs per case (plotResponses equivalent)."""
+    import matplotlib.pyplot as plt
+
+    fig, axs = plt.subplots(len(channels), 1, sharex=True, figsize=(8, 2.5 * len(channels)))
+    axs = np.atleast_1d(axs)
+    f_hz = model.w / (2 * np.pi)
+    for iCase, per_fowt in model.results["case_metrics"].items():
+        m = per_fowt[ifowt]
+        for ax, ch in zip(axs, channels):
+            ax.plot(f_hz, np.asarray(m[f"{ch}_PSD"]), label=f"case {iCase + 1}")
+            ax.set_ylabel(f"{ch} PSD")
+    axs[0].legend()
+    axs[-1].set_xlabel("frequency [Hz]")
+    return fig, axs
