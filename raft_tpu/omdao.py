@@ -1,0 +1,128 @@
+"""Design-optimization API: the WEIS/OpenMDAO-facing surface.
+
+Equivalent of the reference's ``omdao_raft.RAFT_OMDAO``
+(``/root/reference/raft/omdao_raft.py``: inputs :26-343, compute
+:343-818, output mapping :820-887): one ``compute`` call is one design
+evaluation — build the model, solve statics/dynamics over the case
+table, and return flat outputs (platform properties, response
+statistics, natural periods, WEIS aggregates).
+
+Because the heavy path here is jit-compiled jax, an optimizer loop
+amortizes compilation across iterations, and gradient-based optimizers
+can switch to the differentiable design axis in
+:func:`raft_tpu.api.make_design_evaluator` instead of finite
+differences.
+
+The OpenMDAO ``ExplicitComponent`` subclass is provided when openmdao
+is importable (it is not part of this image); the dict-based
+``DesignEvaluation`` below carries the same contract without the
+dependency.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+
+class DesignEvaluation:
+    """One-design-in, flat-metrics-out evaluation for optimizer loops."""
+
+    def __init__(self, base_design):
+        from raft_tpu.structure.schema import load_design
+
+        self.base_design = load_design(base_design)
+
+    def compute(self, overrides=None):
+        """Evaluate a design variant.
+
+        overrides: dict of dotted design-path -> value, e.g.
+        ``{"platform.members.0.d": [...], "mooring.lines.0.length": 870}``.
+        Returns flat outputs (properties_*, per-case stats_*, periods,
+        WEIS aggregates Max_Offset / Max_PtfmPitch).
+        """
+        import raft_tpu
+
+        design = copy.deepcopy(self.base_design)
+        for path, value in (overrides or {}).items():
+            node = design
+            keys = path.split(".")
+            for k in keys[:-1]:
+                node = node[int(k)] if isinstance(node, list) else node[k]
+            k = keys[-1]
+            if isinstance(node, list):
+                node[int(k)] = value
+            else:
+                node[k] = value
+
+        model = raft_tpu.Model(design)
+        model.analyze_cases()
+        stat = model.statics(0)
+
+        out = {
+            # platform properties (omdao_raft.py:253-273)
+            "properties_substructure_mass": float(stat["m_sub"]),
+            "properties_total_mass": float(stat["m"]),
+            "properties_displacement": float(stat["V"]),
+            "properties_AWP": float(stat["AWP"]),
+            "properties_center_of_mass": np.asarray(stat["rCG"]),
+            "properties_center_of_buoyancy": np.asarray(stat["rCB"]),
+            "properties_metacentric_height": float(stat["rM"][2] - stat["rCG"][2]),
+        }
+
+        # natural periods (omdao_raft.py:858-866)
+        fns, _ = model.solve_eigen()
+        out["rigid_body_periods"] = 1.0 / np.maximum(np.asarray(fns), 1e-12)
+
+        # per-case statistics + WEIS aggregates (omdao_raft.py:275-336)
+        max_offset = 0.0
+        max_pitch = 0.0
+        for iCase, per_fowt in model.results["case_metrics"].items():
+            for ifowt, m in per_fowt.items():
+                for ch in ("surge", "sway", "heave", "roll", "pitch", "yaw"):
+                    for s in ("avg", "std", "max"):
+                        out[f"stats_{ch}_{s}_case{iCase}_fowt{ifowt}"] = float(
+                            m[f"{ch}_{s}"])
+                off = np.hypot(float(m["surge_max"]), float(m["sway_max"]))
+                max_offset = max(max_offset, off)
+                max_pitch = max(max_pitch, abs(float(m["pitch_max"])))
+                if "Tmoor_avg" in m:
+                    out[f"stats_Tmoor_max_case{iCase}_fowt{ifowt}"] = float(
+                        np.max(np.asarray(m["Tmoor_max"])))
+        out["Max_Offset"] = max_offset
+        out["Max_PtfmPitch"] = max_pitch
+        return out
+
+
+try:  # OpenMDAO component wrapper (optional dependency)
+    import openmdao.api as om
+
+    class RAFT_TPU_Component(om.ExplicitComponent):
+        """ExplicitComponent exposing DesignEvaluation to WEIS-style
+        optimization problems (omdao_raft.RAFT_OMDAO analog)."""
+
+        def initialize(self):
+            self.options.declare("base_design")
+            self.options.declare("design_vars", types=dict,
+                                 desc="input name -> dotted design path")
+            self.options.declare("outputs", types=list)
+
+        def setup(self):
+            self._eval = DesignEvaluation(self.options["base_design"])
+            for name in self.options["design_vars"]:
+                self.add_input(name)
+            for name in self.options["outputs"]:
+                self.add_output(name)
+
+        def compute(self, inputs, outputs):
+            overrides = {
+                path: float(inputs[name])
+                for name, path in self.options["design_vars"].items()
+            }
+            res = self._eval.compute(overrides)
+            for name in self.options["outputs"]:
+                outputs[name] = res[name]
+
+except ImportError:  # pragma: no cover - openmdao absent in this image
+    RAFT_TPU_Component = None

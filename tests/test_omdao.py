@@ -1,0 +1,24 @@
+"""Optimizer-facing design-evaluation API tests (openmdao-free path)."""
+
+import os
+
+import numpy as np
+
+
+def test_design_evaluation_compute():
+    from raft_tpu.omdao import DesignEvaluation
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "raft_tpu", "designs", "spar_demo.yaml")
+    ev = DesignEvaluation(path)
+    out = ev.compute()
+    assert out["properties_total_mass"] > 1e6
+    assert out["properties_displacement"] > 1e3
+    assert len(out["rigid_body_periods"]) == 6
+    assert out["Max_Offset"] >= 0
+    assert "stats_pitch_std_case0_fowt0" in out
+
+    # an override must change the result (longer mooring -> softer surge)
+    out2 = ev.compute({"mooring.lines.0.length": 920.0})
+    assert out2["stats_surge_max_case0_fowt0"] != out["stats_surge_max_case0_fowt0"]
