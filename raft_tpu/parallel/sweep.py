@@ -51,3 +51,46 @@ def sweep_cases(evaluate, Hs, Tp, beta, mesh=None, out_keys=("PSD", "X0")):
     fn = jax.jit(batched, in_shardings=(sharding, sharding, sharding))
     args = [jax.device_put(jnp.asarray(x), sharding) for x in (Hs, Tp, beta)]
     return fn(*args)
+
+
+def run_sweep_checkpointed(evaluate, Hs, Tp, beta, out_dir, shard_size=256,
+                           mesh=None, out_keys=("PSD", "X0")):
+    """Large design/case sweep with per-shard checkpointing and resume.
+
+    The reference has no checkpoint/resume story for sweeps (SURVEY.md
+    §5.4); here each shard of the batch is evaluated as one sharded
+    program and written to ``<out_dir>/shard_NNNN.npz`` — re-running
+    skips completed shards, so a pre-empted pod job resumes where it
+    stopped.  Returns the dict of concatenated results.
+    """
+    import os
+
+    os.makedirs(out_dir, exist_ok=True)
+    Hs = np.asarray(Hs)
+    Tp = np.asarray(Tp)
+    beta = np.asarray(beta)
+    n = len(Hs)
+    n_shards = (n + shard_size - 1) // shard_size
+    if mesh is None:
+        mesh = make_mesh()
+    ndev = mesh.devices.size
+
+    results = []
+    for s in range(n_shards):
+        path = os.path.join(out_dir, f"shard_{s:04d}.npz")
+        if os.path.exists(path):
+            results.append(dict(np.load(path)))
+            continue
+        sl = slice(s * shard_size, min((s + 1) * shard_size, n))
+        h, t, b = Hs[sl], Tp[sl], beta[sl]
+        pad = (-len(h)) % ndev  # pad the tail shard to the device count
+        if pad:
+            h = np.concatenate([h, np.full(pad, h[-1])])
+            t = np.concatenate([t, np.full(pad, t[-1])])
+            b = np.concatenate([b, np.full(pad, b[-1])])
+        out = sweep_cases(evaluate, h, t, b, mesh=mesh, out_keys=out_keys)
+        out = {k2: np.asarray(v)[: sl.stop - sl.start] for k2, v in out.items()}
+        np.savez(path, **out)
+        results.append(out)
+
+    return {k2: np.concatenate([r[k2] for r in results]) for k2 in out_keys}
