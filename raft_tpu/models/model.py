@@ -41,6 +41,18 @@ class Model:
         # raft_model.py:63-65) so downstream values match golden data
         self.k = wave_number_ref(self.w, self.depth)
 
+        # second-order QTF frequency grid (raft_fowt.py:410-425)
+        platform0 = design.get("platform") or (design.get("platforms") or [{}])[0]
+        if "min_freq2nd" in platform0 and "max_freq2nd" in platform0:
+            mf2 = platform0["min_freq2nd"]
+            Mf2 = platform0["max_freq2nd"]
+            df2 = platform0.get("df_freq2nd", mf2)
+            self.w1_2nd = np.arange(mf2, Mf2 + 0.5 * mf2, df2) * 2 * np.pi
+            self.k1_2nd = wave_number_ref(self.w1_2nd, self.depth)
+        else:
+            self.w1_2nd = None
+            self.k1_2nd = None
+
         self.cases = parse_cases(design)
 
         # ---- FOWT list: single-unit or array mode (raft_model.py:67-162)
@@ -370,11 +382,35 @@ class Model:
                     F_2nd_mean[ih, offs[i]:offs[i] + 6] = fm[:6]
                 F_lin = F_lin + F_2nd[0]
 
-            Z_i, _, Bmat = solve_dynamics_fowt(
+            Z_i, Xi_i, Bmat = solve_dynamics_fowt(
                 fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
                 jnp.asarray(self.w), fh.Tn, fh.r_nodes,
                 n_iter=self.nIter, Xi_start=self.XiStart,
             )
+
+            # internally-computed slender-body QTFs (potSecOrder == 1):
+            # converge first order, compute QTFs from the motion RAOs,
+            # then re-linearise with the 2nd-order forces included
+            # (raft_model.py:1108-1131)
+            if fs.potSecOrder == 1 and self.w1_2nd is not None:
+                from raft_tpu.ops.waves import get_rao
+                from raft_tpu.physics.qtf_slender import fowt_qtf_slender
+                from raft_tpu.physics.secondorder import hydro_force_2nd
+
+                RAO = np.asarray(get_rao(Xi_i[:6], jnp.asarray(fh.zeta[0])))
+                qtf = fowt_qtf_slender(self, 0, Xi0=RAO, ifowt=i)
+                qtf_data = dict(w_2nd=self.w1_2nd,
+                                heads_rad=np.asarray([fh.beta[0]]), qtf=qtf)
+                for ih in range(nWaves):
+                    fm, f2 = hydro_force_2nd(qtf_data, fh.beta[ih], fh.S[ih], self.w)
+                    F_2nd = F_2nd.at[ih, :6, :].add(jnp.asarray(f2[:6]))
+                    F_2nd_mean[ih, offs[i]:offs[i] + 6] += fm[:6]
+                F_lin = F_lin + F_2nd[0]
+                Z_i, Xi_i, Bmat = solve_dynamics_fowt(
+                    fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
+                    jnp.asarray(self.w), fh.Tn, fh.r_nodes,
+                    n_iter=self.nIter, Xi_start=self.XiStart,
+                )
             Z_blocks.append(Z_i)
             Bmats.append(Bmat)
             infos.append(dict(S=fh.S, zeta=fh.zeta, exc=exc, tc=tc))

@@ -1,0 +1,163 @@
+"""Second-order wave kinematics helpers (jax).
+
+Twins of the reference's second-order wave field functions
+(``/root/reference/raft/helpers.py``: ``getWaveKin_grad_u1`` :239,
+``getWaveKin_grad_dudt`` :280, ``getWaveKin_grad_pres1st`` :284,
+``getWaveKin_axdivAcc`` :310, ``getWaveKin_pot2ndOrd`` :336), used by
+the slender-body QTF computation.
+
+NOTE on a replicated reference quirk: these helpers receive the wave
+heading in RADIANS from the QTF call chain, but apply ``deg2rad`` to it
+for the direction cosines while using the raw value inside the phase
+factor (helpers.py:244-246 vs :260).  The golden QTF data encodes this
+mixed-units behavior, so it is reproduced verbatim here; pass
+``beta_rad`` exactly as the reference does.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+DEG2RAD = 0.017453292519943295
+
+
+def _khz(k, h, z, denom="sinh"):
+    """cosh/sinh(k(z+h)) / {sinh,cosh}(kh) with the reference's deep-water
+    switch at k h >= 10 (helpers.py:250-257)."""
+    kh = k * h
+    deep = kh >= 10.0
+    arg_zh = jnp.where(deep, 0.0, k * (z + h))
+    arg_h = jnp.where(deep, 1.0, kh)
+    den = jnp.sinh(arg_h) if denom == "sinh" else jnp.cosh(arg_h)
+    khz_xy = jnp.where(deep, jnp.exp(k * z), jnp.cosh(arg_zh) / den)
+    khz_z = jnp.where(deep, jnp.exp(k * z), jnp.sinh(arg_zh) / den)
+    return khz_xy, khz_z
+
+
+def grad_u1(w, k, beta_rad, h, r):
+    """(3,3) complex gradient of first-order velocity at point r.
+
+    helpers.py:239-277 — including the deg2rad-of-radians quirk."""
+    x, y, z = r[0], r[1], r[2]
+    cosB = jnp.cos(DEG2RAD * beta_rad)
+    sinB = jnp.sin(DEG2RAD * beta_rad)
+    khz_xy, khz_z = _khz(k, h, z, denom="sinh")
+    active = (z <= 0) & (k > 0)
+
+    phase = jnp.exp(-1j * (k * (jnp.cos(beta_rad) * x + jnp.sin(beta_rad) * y)))
+    aux_x = w * cosB * phase
+    aux_y = w * sinB * phase
+    aux_z = 1j * w * phase
+
+    g00 = -1j * aux_x * khz_xy * k * cosB
+    g01 = -1j * aux_x * khz_xy * k * sinB
+    g02 = aux_x * k * khz_z
+    g11 = -1j * aux_y * khz_xy * k * sinB
+    g12 = aux_y * k * khz_z
+    g22 = aux_z * k * khz_xy
+    G = jnp.array([
+        [g00, g01, g02],
+        [g01, g11, g12],
+        [g02, g01, g22],  # reference sets grad[2,1] = grad[0,1] (:274)
+    ])
+    return jnp.where(active, G, 0.0)
+
+
+def grad_dudt(w, k, beta_rad, h, r):
+    return 1j * w * grad_u1(w, k, beta_rad, h, r)
+
+
+def grad_pres1st(k, beta_rad, h, r, rho=1025.0, g=9.81):
+    """(3,) complex gradient of first-order pressure; helpers.py:284-307."""
+    x, y, z = r[0], r[1], r[2]
+    cosB = jnp.cos(DEG2RAD * beta_rad)
+    sinB = jnp.sin(DEG2RAD * beta_rad)
+    khz_xy, khz_z = _khz(k, h, z, denom="cosh")
+    active = (z <= 0) & (k > 0)
+    phase = jnp.exp(-1j * (k * (cosB * x + sinB * y)))
+    out = jnp.array([
+        rho * g * khz_xy * phase * (-1j * k * cosB),
+        rho * g * khz_xy * phase * (-1j * k * sinB),
+        rho * g * khz_z * phase * k,
+    ])
+    return jnp.where(active, out, 0.0)
+
+
+def _u_single(w, k, beta_rad, h, r, rho=1025.0, g=9.81):
+    """First-order velocity amplitude for unit elevation at one (w, k);
+    mirrors getWaveKin for a single component (helpers.py:188-236)."""
+    x, y, z = r[0], r[1], r[2]
+    zeta = jnp.exp(-1j * (k * (jnp.cos(beta_rad) * x + jnp.sin(beta_rad) * y)))
+    kh = k * h
+    deep = kh > 89.4
+    kzero = k == 0.0
+    arg_zh = jnp.where(deep | kzero, 0.0, k * (z + h))
+    arg_h = jnp.where(deep | kzero, 1.0, kh)
+    SINH = jnp.sinh(arg_zh) / jnp.sinh(arg_h)
+    COSHs = jnp.cosh(arg_zh) / jnp.sinh(arg_h)
+    ekz = jnp.exp(jnp.minimum(k * z, 0.0))
+    SINH = jnp.where(deep, ekz, jnp.where(kzero, 1.0, SINH))
+    COSHs = jnp.where(deep, ekz, jnp.where(kzero, 99999.0, COSHs))
+    u = jnp.array([
+        w * zeta * COSHs * jnp.cos(beta_rad),
+        w * zeta * COSHs * jnp.sin(beta_rad),
+        1j * w * zeta * SINH,
+    ])
+    return jnp.where(z <= 0, u, 0.0)
+
+
+def axdiv_acc(w1, w2, k1, k2, beta_rad, h, r, vel1, vel2, q, g=9.81):
+    """Rainey axial-divergence acceleration; helpers.py:310-333."""
+    aux1 = grad_u1(w1, k1, beta_rad, h, r) @ q
+    dwdz1 = jnp.dot(aux1, q)
+    u1 = _u_single(w1, k1, beta_rad, h, r, g=g)
+    aux2 = grad_u1(w2, k2, beta_rad, h, r) @ q
+    dwdz2 = jnp.dot(aux2, q)
+    u2 = _u_single(w2, k2, beta_rad, h, r, g=g)
+
+    v1 = vel1 - jnp.dot(vel1, q) * q
+    v2 = vel2 - jnp.dot(vel2, q) * q
+    u1p = u1 - jnp.dot(u1, q) * q
+    u2p = u2 - jnp.dot(u2, q) * q
+
+    acc = 0.25 * (dwdz1 * jnp.conj(u2p - v2) + jnp.conj(dwdz2) * (u1p - v1))
+    acc = acc - jnp.dot(acc, q) * q
+    return acc
+
+
+def pot_2nd_ord(w1, w2, k1, k2, beta_rad, h, r, g=9.81, rho=1025.0):
+    """Difference-frequency second-order potential acceleration and
+    pressure; helpers.py:336-373 (with the deg2rad quirk)."""
+    b = DEG2RAD * beta_rad
+    cosB, sinB = jnp.cos(b), jnp.sin(b)
+    z = r[2]
+    k1_k2 = jnp.array([k1 * cosB - k2 * cosB, k1 * sinB - k2 * sinB, 0.0])
+    nk = jnp.linalg.norm(k1_k2)
+    nk_safe = jnp.where(nk == 0, 1e-30, nk)
+
+    dw2 = (w1 - w2) ** 2
+    den1 = dw2 / g - nk * jnp.tanh(nk_safe * h)
+    den1 = jnp.where(jnp.abs(den1) < 1e-30, 1e-30, den1)
+    g12 = (-1j * g / (2 * w1)) * (
+        k1**2 * (1 - jnp.tanh(k1 * h) ** 2)
+        - 2 * k1 * k2 * (1 + jnp.tanh(k1 * h) * jnp.tanh(k2 * h))
+    ) / den1
+    g21 = (-1j * g / (2 * w2)) * (
+        k2**2 * (1 - jnp.tanh(k2 * h) ** 2)
+        - 2 * k2 * k1 * (1 + jnp.tanh(k2 * h) * jnp.tanh(k1 * h))
+    ) / den1
+    aux = 0.5 * (g21 + jnp.conj(g12))
+
+    khz_xy = jnp.cosh(nk_safe * (z + h)) / jnp.cosh(nk_safe * h)
+    khz_z = jnp.sinh(nk_safe * (z + h)) / jnp.cosh(nk_safe * h)
+    phase = jnp.exp(-1j * jnp.dot(k1_k2, r))
+
+    acc = jnp.array([
+        aux * khz_xy * phase * (w1 - w2) * (k1 * cosB - k2 * cosB),
+        aux * khz_xy * phase * (w1 - w2) * (k1 * sinB - k2 * sinB),
+        aux * khz_z * phase * 1j * (w1 - w2) * nk,
+    ])
+    p = aux * khz_xy * phase * (-1j) * rho * (w1 - w2)
+
+    active = (z <= 0) & (k1 > 0) & (k2 > 0) & (w1 != w2)
+    return jnp.where(active, acc, 0.0), jnp.where(active, p, 0.0)
