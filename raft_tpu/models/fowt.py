@@ -193,15 +193,21 @@ class FOWTStructure:
         """Joints + nodes + reduction; raft_fowt.py:183-339."""
         topo = Topology()
 
-        # one node per member (rigid members: single node at rA0;
-        # raft_member.py:273-287).  Beams not yet supported.
+        # nodes per member: rigid members have a single node at rA0;
+        # beams get one node per strip (raft_member.py:273-287)
         member_nodes = []
         for im, mem in enumerate(self.members):
-            if mem.mtype != "rigid":
-                raise NotImplementedError(
-                    "flexible (beam) members not yet supported in raft_tpu"
-                )
-            member_nodes.append(topo.add_node(mem.rA0, "member", owner=im).id)
+            if mem.mtype == "rigid":
+                member_nodes.append(topo.add_node(mem.rA0, "member", owner=im).id)
+            else:
+                r = mem.rA0[None, :] + mem.q0[None, :] * mem.ls[:, None]
+                ids = []
+                for i in range(mem.ns):
+                    end = i == 0 or i == mem.ns - 1
+                    ids.append(topo.add_node(r[i], "member", owner=im,
+                                             end_node=end).id)
+                topo.add_chain(ids)
+                member_nodes.append(ids[0])
         rotor_nodes = []
         for ir, rot in enumerate(self.rotors):
             rotor_nodes.append(topo.add_node(rot.r_rel, "rotor", owner=ir).id)
@@ -243,14 +249,17 @@ class FOWTStructure:
                         chosen = [idxs[count_heading]]
                     for im in chosen:
                         topo.attach_node_to_joint(
-                            topo.nodes[member_nodes[im]], joint
+                            self._closest_end_node(topo, member_nodes, im, joint),
+                            joint,
                         )
 
         # rotor-to-tower joints (raft_fowt.py:303-312)
         tower_member_idx = [i for i, m in enumerate(self.members) if m.part_of == "tower"]
         for ir, rot in enumerate(self.rotors):
             joint = topo.add_joint(rot.r_rel, "cantilever", "tower2rotor")
-            topo.attach_node_to_joint(topo.nodes[member_nodes[tower_member_idx[ir]]], joint)
+            topo.attach_node_to_joint(
+                self._closest_end_node(topo, member_nodes, tower_member_idx[ir], joint),
+                joint)
             topo.attach_node_to_joint(topo.nodes[rotor_nodes[ir]], joint)
 
         T, dT, reducedDOF, root_id = topo.reduce_with_derivative()
@@ -268,3 +277,21 @@ class FOWTStructure:
         self.is_single_body = self.nDOF == 6 and all(
             d[0] == root_id for d in reducedDOF
         )
+
+    @staticmethod
+    def _closest_end_node(topo, member_nodes, im, joint):
+        """The member end node closest to the joint (raft_fowt.py:498-511)."""
+        first = member_nodes[im]
+        n0 = topo.nodes[first]
+        # find the member's last node (same owner, contiguous ids)
+        last = first
+        while (last + 1 < len(topo.nodes)
+               and topo.nodes[last + 1].kind == "member"
+               and topo.nodes[last + 1].owner == n0.owner):
+            last += 1
+        if last == first:
+            return n0
+        n1 = topo.nodes[last]
+        dA = np.linalg.norm(n0.r0 - joint["r"])
+        dB = np.linalg.norm(n1.r0 - joint["r"])
+        return n0 if dA < dB else n1

@@ -70,18 +70,45 @@ class FOWTHydro:
         from raft_tpu.utils.devices import on_cpu, to_host
 
         with on_cpu():
-            r0_nodes, R0, root0 = platform_kinematics(fs, jnp.zeros(fs.nDOF))
-            Tn0 = node_T(r0_nodes, root0)
+            r0_nodes, R0, root0, Tn0 = self._kinematics(np.zeros(fs.nDOF))
             self.hc0 = to_host(
                 morison.hydro_constants(fs, self.strips, R0, r0_nodes, Tn0)
             )
             self.set_position(np.zeros(fs.nDOF))
 
+    def _kinematics(self, Xi0):
+        """Node positions / platform rotation / per-node reduction rows.
+
+        Single rigid bodies use the exact nonlinear rigid kinematics;
+        general (flexible/multibody) structures use the linear map
+        r = r0 + (T Xi0) with the build-time T (small mean deflections).
+        """
+        fs = self.fs
+        Xi0 = jnp.asarray(Xi0, dtype=float)
+        if fs.is_single_body:
+            r_nodes, R_ptfm, r_root = platform_kinematics(fs, Xi0)
+            Tn = node_T(r_nodes, r_root)
+            return r_nodes, R_ptfm, r_root, Tn
+        disp = (np.asarray(fs.T) @ np.asarray(Xi0)).reshape(fs.n_nodes, 6)
+        r_np = fs.node_r0 + disp[:, :3]
+        # T depends on the current node positions through the rigid-link
+        # offsets (reference recomputes reduceDOF after setPosition,
+        # raft_fowt.py:774); rebuild it at the displaced positions
+        if np.any(disp):
+            T_disp, _, _ = fs.topology.reduce(positions=r_np)
+        else:
+            T_disp = fs.T
+        r_nodes = jnp.asarray(r_np)
+        Tn = jnp.asarray(T_disp.reshape(fs.n_nodes, 6, fs.nDOF))
+        self._node_rot = jnp.asarray(disp[:, 3:])  # member axes track node rotations
+        return r_nodes, jnp.eye(3), r_nodes[fs.root_id], Tn
+
     def set_position(self, Xi0):
         self.Xi0 = jnp.asarray(Xi0, dtype=float)
-        self.r_nodes, self.R_ptfm, self.r_root = platform_kinematics(self.fs, self.Xi0)
-        self.Tn = node_T(self.r_nodes, self.r_root)
-        r, q, p1, p2 = morison.strip_frames(self.strips, self.R_ptfm, self.r_nodes)
+        self._node_rot = None
+        self.r_nodes, self.R_ptfm, self.r_root, self.Tn = self._kinematics(self.Xi0)
+        r, q, p1, p2 = morison.strip_frames(
+            self.strips, self.R_ptfm, self.r_nodes, node_rot=self._node_rot)
         sub = r[:, 2] < 0
         self.hc = dict(
             self.hc0,

@@ -196,9 +196,11 @@ class Model:
         import scipy.linalg
 
         K_blocks, F_und_parts, F_env_parts = [], [], []
+        C_elast_blocks = []
         for i, fs in enumerate(self.fowtList):
             stat = self.statics(i)
             K_blocks.append(np.asarray(stat["C_struc"] + stat["C_hydro"]))
+            C_elast_blocks.append(np.asarray(stat["C_elast"]))
             F_und_parts.append(
                 np.asarray(stat["W_struc"] + stat["W_hydro"] + stat["f0_additional"]))
             F_env = jnp.zeros(fs.nDOF)
@@ -213,10 +215,12 @@ class Model:
         if extra_force is not None:
             F_env = F_env + jnp.asarray(extra_force)
 
+        C_elast = jnp.asarray(scipy.linalg.block_diag(*C_elast_blocks))
         tol_vec, caps, refs = make_tolerances(self.fowtList)
         force, stiff = self._mooring_closures()
         X, Fres = solve_equilibrium_general(
-            K_h, F_und, F_env, force, stiff, tol_vec, caps, refs)
+            K_h, F_und, F_env, force, stiff, tol_vec, caps, refs,
+            C_elast=C_elast)
         self.X0 = X
         return X
 
@@ -536,8 +540,14 @@ class Model:
         C_tot += np.asarray(stiff(jnp.asarray(X0)))
 
         eigenvals, eigenvectors = np.linalg.eig(np.linalg.solve(M_tot, C_tot))
-        if np.any(eigenvals <= 0.0):
+        if np.any(eigenvals.real <= 0.0):
             raise RuntimeError("zero or negative system eigenvalues detected")
+
+        # flexible/multibody systems: ascending sort (raft_model.py:518-527)
+        if not all(f.nDOF == 6 for f in self.fowtList):
+            order = np.argsort(eigenvals.real)
+            fns = np.sqrt(eigenvals[order].real) / 2.0 / np.pi
+            return fns, eigenvectors[:, order]
 
         nDOF = self.nDOF
         # DOF-claiming sort (raft_model.py:499-516)

@@ -134,6 +134,11 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
         if not tower_idx:
             continue
         mem_tower = fs.members[tower_idx[ir]]
+        if mem_tower.mtype != "rigid":
+            # flexible towers report FE internal base loads (Fbase/Mbase
+            # components, raft_fowt.py:2541-2604) — pending milestone;
+            # Mbase_* stays zero as in the reference's rigid-only branch
+            continue
         mtower = float(stat["mtower"][ir])
         rCG_tow = np.asarray(stat["rCG_tow"][ir])
         m_turb = mtower + rot.mRNA

@@ -38,6 +38,7 @@ class StripSet:
     """Flattened strip arrays across all members of one FOWT."""
 
     node: np.ndarray      # (S,) structural node index of each strip
+    mnode0: np.ndarray    # (S,) index of the strip's member's FIRST node
     ls: np.ndarray        # (S,) axial position along member
     dls: np.ndarray       # (S,)
     ds: np.ndarray        # (S,2)
@@ -69,15 +70,21 @@ def build_strips(fs, k_array=None):
     fs : FOWTStructure;  k_array : (nw,) wave numbers for MCF members.
     """
     cols = {f: [] for f in (
-        "node ls dls ds drs circ active q0 p10 p20 "
+        "node mnode0 ls dls ds drs circ active q0 p10 p20 "
         "Cd_q Cd_p1 Cd_p2 Cd_End Ca_q Ca_p1 Ca_p2 Ca_End".split()
     )}
     mcf_rows = []
     nw = len(k_array) if k_array is not None else 1
     for im, mem in enumerate(fs.members):
         ns = mem.ns
-        cols["node"] += [int(fs.member_node[im])] * ns
-        cols["ls"] += list(mem.ls)
+        if mem.mtype == "rigid":
+            cols["node"] += [int(fs.member_node[im])] * ns
+            cols["ls"] += list(mem.ls)  # axial offset from the member node
+        else:
+            # beam strips sit exactly at their own structural nodes
+            cols["node"] += [int(fs.member_node[im]) + i for i in range(ns)]
+            cols["ls"] += [0.0] * ns
+        cols["mnode0"] += [int(fs.member_node[im])] * ns
         cols["dls"] += list(mem.dls)
         cols["ds"] += list(mem.ds)
         cols["drs"] += list(mem.drs)
@@ -119,15 +126,25 @@ def build_strips(fs, k_array=None):
 
 # ------------------------------------------------------------- kinematics
 
-def strip_frames(ss: StripSet, R_ptfm, r_nodes):
+def strip_frames(ss: StripSet, R_ptfm, r_nodes, node_rot=None):
     """Strip positions and member axes under the current pose.
 
     r_strip = r_node + q * ls (rigid members; raft_member.py:359-362).
+    For general structures, each member rotates with its first node
+    (member.setPosition uses nodeList[0].r[3:], raft_member.py:348-357):
+    pass node_rot (N, 3) rotations and R_ptfm is ignored per strip.
     Returns (r (S,3), q, p1, p2 each (S,3)).
     """
-    q = jnp.asarray(ss.q0) @ R_ptfm.T
-    p1 = jnp.asarray(ss.p10) @ R_ptfm.T
-    p2 = jnp.asarray(ss.p20) @ R_ptfm.T
+    if node_rot is not None:
+        th = node_rot[jnp.asarray(ss.mnode0)]  # (S, 3)
+        R = tf.rotation_matrix(th[:, 0], th[:, 1], th[:, 2])  # (S,3,3)
+        q = jnp.einsum("sij,sj->si", R, jnp.asarray(ss.q0))
+        p1 = jnp.einsum("sij,sj->si", R, jnp.asarray(ss.p10))
+        p2 = jnp.einsum("sij,sj->si", R, jnp.asarray(ss.p20))
+    else:
+        q = jnp.asarray(ss.q0) @ R_ptfm.T
+        p1 = jnp.asarray(ss.p10) @ R_ptfm.T
+        p2 = jnp.asarray(ss.p20) @ R_ptfm.T
     r = r_nodes[jnp.asarray(ss.node)] + q * jnp.asarray(ss.ls)[:, None]
     return r, q, p1, p2
 

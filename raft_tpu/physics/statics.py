@@ -247,10 +247,17 @@ def calc_statics(fs, Xi0=None):
     """
     rho, g = fs.rho_water, fs.g
     nDOF = fs.nDOF
+    if not fs.is_single_body:
+        # mixed rigid/flexible structures use the general numpy path at
+        # the reference pose (see physics/statics_general.py)
+        if Xi0 is not None and np.any(np.asarray(Xi0) != 0):
+            raise NotImplementedError(
+                "general statics currently evaluates at the reference pose")
+        from raft_tpu.physics.statics_general import calc_statics_general
+
+        return calc_statics_general(fs)
     if Xi0 is None:
         Xi0 = jnp.zeros(nDOF)
-    if not fs.is_single_body:
-        raise NotImplementedError("multibody statics pending (round-1 scope)")
 
     r_nodes, R_ptfm, r_root = platform_kinematics(fs, Xi0)
     Tn = node_T(r_nodes, r_root)  # (N, 6, 6)

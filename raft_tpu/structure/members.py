@@ -161,6 +161,19 @@ class MemberGeometry:
     pfill: list = field(default_factory=list)
     vfill: list = field(default_factory=list)
 
+    # beam (flexible) member data
+    E: float = 0.0
+    G: float = 0.0
+    dorsl_node_ext: np.ndarray | None = None  # (ns, 2) external d/side at strip nodes
+    dorsl_node_int: np.ndarray | None = None  # (ns, 2) internal
+    # per-node lumped ballast/cap data for beams (raft_member.py:550-657, 806-823)
+    node_ballast_mass: np.ndarray | None = None    # (ns,)
+    node_ballast_center: np.ndarray | None = None  # (ns, 3) wrt rA in member coords (global at ref pose)
+    node_ballast_I: np.ndarray | None = None       # (ns, 3) local principal MoI about its CG
+    node_cap_mass: np.ndarray | None = None
+    node_cap_center: np.ndarray | None = None
+    node_cap_I: np.ndarray | None = None
+
     @property
     def ns(self):
         return len(self.ls)
@@ -229,31 +242,42 @@ def build_member(mi, heading=0.0, part_of="platform", global_dlsMax=5.0):
     dls = [0.0]
     ds = [0.5 * dorsl[0]]
     drs = [0.5 * dorsl[0]]
+    d_node_ext = [dorsl[0]]
+    d_node_int = [dorsl_int[0]]
     for i in range(1, n):
         lstrip = stations[i] - stations[i - 1]
         if lstrip > 0.0:
             ns_i = int(np.ceil(lstrip / dlsMax))
             dlstrip = lstrip / ns_i
             m = 0.5 * (dorsl[i] - dorsl[i - 1]) / lstrip
+            m_int = 0.5 * (dorsl_int[i] - dorsl_int[i - 1]) / lstrip
             ls += [stations[i - 1] + dlstrip * (0.5 + j) for j in range(ns_i)]
             dls += [dlstrip] * ns_i
             ds += [dorsl[i - 1] + dlstrip * 2 * m * (0.5 + j) for j in range(ns_i)]
             drs += [dlstrip * m] * ns_i
+            d_node_ext += [dorsl[i - 1] + dlstrip * 2 * m * (0.5 + j) for j in range(ns_i)]
+            d_node_int += [dorsl_int[i - 1] + dlstrip * 2 * m_int * (0.5 + j) for j in range(ns_i)]
         elif lstrip == 0.0:
             ls += [stations[i - 1]]
             dls += [0.0]
             ds += [0.5 * (dorsl[i - 1] + dorsl[i])]
             drs += [0.5 * (dorsl[i] - dorsl[i - 1])]
+            d_node_ext += [dorsl[i - 1]]
+            d_node_int += [dorsl_int[i - 1]]
     # end B strip (raft_member.py:245-254)
     ls += [stations[-1]]
     dls += [0.0]
     ds += [0.5 * dorsl[-1]]
     drs += [-0.5 * dorsl[-1]]
+    d_node_ext += [dorsl[-1]]
+    d_node_int += [dorsl_int[-1]]
 
     ls = np.array(ls, dtype=float)
     dls = np.array(dls, dtype=float)
     ds = np.stack([np.broadcast_to(x, (2,)) for x in ds])
     drs = np.stack([np.broadcast_to(x, (2,)) for x in drs])
+    d_node_ext = np.stack([np.broadcast_to(x, (2,)) for x in d_node_ext])
+    d_node_int = np.stack([np.broadcast_to(x, (2,)) for x in d_node_int])
 
     # ----- member axes at reference pose (raft_member.py:312-345) -----
     q = (rB0 - rA0) / l
@@ -314,99 +338,114 @@ def build_member(mi, heading=0.0, part_of="platform", global_dlsMax=5.0):
         elem_Ixx=np.zeros(0),
         elem_Iyy=np.zeros(0),
         elem_Izz=np.zeros(0),
+        E=float(np.atleast_1d(mi.get("E", [0.0]))[0]) if "E" in mi else 0.0,
+        G=float(np.atleast_1d(mi.get("G", [0.0]))[0]) if "G" in mi else 0.0,
+        dorsl_node_ext=d_node_ext,
+        dorsl_node_int=d_node_int,
     )
-    _build_inertia_elements(geom, mi)
+    if mtype == "beam":
+        _build_beam_node_data(geom, mi)
+    else:
+        _build_inertia_elements(geom, mi)
     return geom
 
 
-def _build_inertia_elements(g: MemberGeometry, mi):
-    """Precompute shell+ballast section and cap inertia elements.
+def _build_beam_node_data(g: MemberGeometry, mi):
+    """Per-node lumped ballast and cap data for flexible members.
 
-    Rigid-member branch of Member.getInertia (raft_member.py:412-541)
-    and the cap/bulkhead block (raft_member.py:659-823), reduced to
-    (mass, axial CG offset, local principal MoI about CG) per element.
+    Beam branch of Member.getInertia (raft_member.py:550-657): ballast
+    in each section is split between nodes by their half-spacing zones;
+    caps lump at the closest node (:806-823).
     """
-    n = len(g.stations)
-    masses, ss, Ixxs, Iyys, Izzs = [], [], [], [], []
-    mshell = 0.0
-    mfill, pfill, vfill = [], [], []
+    ns = g.ns
+    nodes_s = g.ls.copy()  # node positions along axis (straight member)
+    dist_p = np.diff(nodes_s, prepend=0)
+    dist_n = np.diff(nodes_s, append=nodes_s[-1])
 
+    mass_b = np.zeros(ns)
+    center_b = np.zeros((ns, 3))
+    I_b = np.zeros((ns, 3))
+    n = len(g.stations)
+    mfill, pfill, vfill = [], [], []
     for i in range(1, n):
         lsec = g.stations[i] - g.stations[i - 1]
-        if lsec <= 0:
-            # Reference quirk (replicated for parity): getInertia does not
-            # reset Ixx/Iyy/Izz per iteration, so a zero-length section
-            # re-adds the PREVIOUS section's CG inertia with zero mass
-            # (raft_member.py:413-540: `if l > 0` skips the recompute but
-            # the Mmat/I accumulation below it still runs).
-            if masses:
-                masses.append(0.0)
-                ss.append(0.0)
-                Ixxs.append(Ixxs[-1])
-                Iyys.append(Iyys[-1])
-                Izzs.append(Izzs[-1])
-            vfill.append(0.0)
-            mfill.append(0.0)
-            pfill.append(0.0)
-            continue
-        l_fill = g.l_fill[i - 1] if np.ndim(g.l_fill) else g.l_fill
-        rho_fill = g.rho_fill[i - 1] if np.ndim(g.rho_fill) else g.rho_fill
-
-        if g.circular:
-            dA, dB = g.d[i - 1, 0], g.d[i, 0]
-            dAi = dA - 2 * g.t[i - 1]
-            dBi = dB - 2 * g.t[i]
-            V_o, hco = _frustum_vcv(dA, dB, lsec)
-            V_i, hci = _frustum_vcv(dAi, dBi, lsec)
-            v_shell = V_o - V_i
-            m_shell = v_shell * g.rho_shell
-            hc_shell = ((hco * V_o) - (hci * V_i)) / (V_o - V_i) if V_o != V_i else 0.0
-            dBi_fill = (dBi - dAi) * (l_fill / lsec) + dAi
-            v_fill, hc_fill = _frustum_vcv(dAi, dBi_fill, l_fill)
-            m_fill = v_fill * rho_fill
-            mass = m_shell + m_fill
-            hc = ((hc_fill * m_fill) + (hc_shell * m_shell)) / mass if mass != 0 else 0.0
-            Ir_o, Ia_o = _frustum_moi(dA, dB, lsec, g.rho_shell)
-            Ir_i, Ia_i = _frustum_moi(dAi, dBi, lsec, g.rho_shell)
-            Ir_f, Ia_f = _frustum_moi(dAi, dBi_fill, l_fill, rho_fill)
-            I_rad_end = (Ir_o - Ir_i) + Ir_f
-            I_rad = I_rad_end - mass * hc**2
-            I_ax = (Ia_o - Ia_i) + Ia_f
-            Ixx, Iyy, Izz = I_rad, I_rad, I_ax
-        else:
-            slA, slB = g.d[i - 1], g.d[i]
-            slAi = slA - 2 * g.t[i - 1]
-            slBi = slB - 2 * g.t[i]
-            V_o, hco = _frustum_vcv(slA, slB, lsec)
-            V_i, hci = _frustum_vcv(slAi, slBi, lsec)
-            v_shell = V_o - V_i
-            m_shell = v_shell * g.rho_shell
-            hc_shell = ((hco * V_o) - (hci * V_i)) / (V_o - V_i) if V_o != V_i else 0.0
-            slBi_fill = (slBi - slAi) * (l_fill / lsec) + slAi
-            v_fill, hc_fill = _frustum_vcv(slAi, slBi_fill, l_fill)
-            m_fill = v_fill * rho_fill
-            mass = m_shell + m_fill
-            hc = ((hc_fill * m_fill) + (hc_shell * m_shell)) / mass if mass != 0 else 0.0
-            Ix_o, Iy_o, Iz_o = _rect_moi(slA[0], slA[1], slB[0], slB[1], lsec, g.rho_shell)
-            Ix_i, Iy_i, Iz_i = _rect_moi(slAi[0], slAi[1], slBi[0], slBi[1], lsec, g.rho_shell)
-            Ix_f, Iy_f, Iz_f = _rect_moi(
-                slAi[0], slAi[1], slBi_fill[0], slBi_fill[1], l_fill, rho_fill
-            )
-            Ixx = (Ix_o - Ix_i) + Ix_f - mass * hc**2
-            Iyy = (Iy_o - Iy_i) + Iy_f - mass * hc**2
-            Izz = (Iz_o - Iz_i) + Iz_f
-
-        masses.append(mass)
-        ss.append(g.stations[i - 1] + hc)
-        Ixxs.append(Ixx)
-        Iyys.append(Iyy)
-        Izzs.append(Izz)
-        mshell += m_shell
-        vfill.append(float(np.ravel(v_fill)[0]) if np.ndim(v_fill) else float(v_fill))
-        mfill.append(float(m_fill))
+        sec_mass = 0.0
+        sec_v = 0.0
+        rho_fill = g.rho_fill[i - 1] if lsec > 0 else 0.0
+        if lsec > 0:
+            l_fill = g.l_fill[i - 1]
+            for inode in range(ns):
+                s_lo = max(nodes_s[inode] - dist_p[inode] / 2, g.stations[i - 1])
+                s_hi = min(nodes_s[inode] + dist_n[inode] / 2, g.stations[i - 1] + l_fill)
+                l_node = s_hi - s_lo
+                if l_node <= 0:
+                    continue
+                if g.circular:
+                    dA_st = g.d[i - 1, 0] - 2 * g.t[i - 1]
+                    dB_st = g.d[i, 0] - 2 * g.t[i]
+                    dA = (dB_st - dA_st) * ((s_lo - g.stations[i - 1]) / lsec) + dA_st
+                    dB = (dB_st - dA_st) * ((s_hi - g.stations[i - 1]) / lsec) + dA_st
+                    v_n, hc_n = _frustum_vcv(dA, dB, l_node)
+                    m_n = v_n * rho_fill
+                    Ir_end, Ia = _frustum_moi(dA, dB, l_node, rho_fill)
+                    Ir = Ir_end - m_n * hc_n**2
+                    Ixx, Iyy, Izz = Ir, Ir, Ia
+                else:
+                    slA_st = g.d[i - 1] - 2 * g.t[i - 1]
+                    slB_st = g.d[i] - 2 * g.t[i]
+                    slA = (slB_st - slA_st) * ((s_lo - g.stations[i - 1]) / lsec) + slA_st
+                    slB = (slB_st - slA_st) * ((s_hi - g.stations[i - 1]) / lsec) + slA_st
+                    v_n, hc_n = _frustum_vcv(slA, slB, l_node)
+                    m_n = v_n * rho_fill
+                    Ix_e, Iy_e, Iz_e = _rect_moi(slA[0], slA[1], slB[0], slB[1], l_node, rho_fill)
+                    Ixx = Ix_e - m_n * hc_n**2
+                    Iyy = Iy_e - m_n * hc_n**2
+                    Izz = Iz_e
+                center = g.rA0 + g.q0 * (s_lo + hc_n)
+                mass_b[inode] += m_n
+                center_b[inode] += center * m_n
+                I_b[inode] += np.array([Ixx, Iyy, Izz])
+                sec_mass += m_n
+                sec_v += v_n
+        vfill.append(float(sec_v))
+        mfill.append(float(sec_mass))
         pfill.append(float(rho_fill))
+    nonzero = mass_b > 0
+    center_b[nonzero] /= mass_b[nonzero, None]
 
-    # ----- caps / bulkheads (raft_member.py:659-823) -----
+    # caps lump at the closest node (raft_member.py:806-823)
+    mass_c = np.zeros(ns)
+    center_c = np.zeros((ns, 3))
+    I_c = np.zeros((ns, 3))
+    m_caps_total = 0.0
+    for (m_cap, s_cg, Ix, Iy, Iz) in _cap_elements(g, mi):
+        center_cap = g.rA0 + g.q0 * s_cg
+        inode = int(np.argmin(np.linalg.norm(
+            (g.rA0[None, :] + g.q0[None, :] * nodes_s[:, None]) - center_cap[None, :],
+            axis=1)))
+        mass_c[inode] += m_cap
+        center_c[inode] += center_cap * m_cap
+        I_c[inode] += np.array([Ix, Iy, Iz])
+        m_caps_total += m_cap
+    nz = mass_c > 0
+    center_c[nz] /= mass_c[nz, None]
+
+    g.node_ballast_mass = mass_b
+    g.node_ballast_center = center_b
+    g.node_ballast_I = I_b
+    g.node_cap_mass = mass_c
+    g.node_cap_center = center_c
+    g.node_cap_I = I_c
+    g.mshell = m_caps_total  # shell mass itself comes from the FE matrix
+    g.mfill = mfill
+    g.pfill = pfill
+    g.vfill = vfill
+
+
+def _cap_elements(g: MemberGeometry, mi):
+    """Cap/bulkhead inertia elements (raft_member.py:659-823):
+    list of (mass, axial CG offset, Ixx, Iyy, Izz about CG, local axes)."""
+    out = []
     cap_stations_in = coerce(mi, "cap_stations", shape=-1, default=[])
     if len(np.atleast_1d(cap_stations_in)) > 0:
         cap_st_in = np.atleast_1d(np.array(cap_stations_in, dtype=float))
@@ -536,12 +575,106 @@ def _build_inertia_elements(g: MemberGeometry, mi):
             else:
                 s_cg = L - (h / 2 - hc_cap)
 
-            masses.append(m_cap)
-            ss.append(s_cg)
-            Ixxs.append(Ixx)
-            Iyys.append(Iyy)
-            Izzs.append(Izz)
-            mshell += m_cap
+            out.append((m_cap, s_cg, Ixx, Iyy, Izz))
+
+    return out
+
+
+def _build_inertia_elements(g: MemberGeometry, mi):
+    """Precompute shell+ballast section and cap inertia elements.
+
+    Rigid-member branch of Member.getInertia (raft_member.py:412-541)
+    and the cap/bulkhead block (raft_member.py:659-823), reduced to
+    (mass, axial CG offset, local principal MoI about CG) per element.
+    """
+    n = len(g.stations)
+    masses, ss, Ixxs, Iyys, Izzs = [], [], [], [], []
+    mshell = 0.0
+    mfill, pfill, vfill = [], [], []
+
+    for i in range(1, n):
+        lsec = g.stations[i] - g.stations[i - 1]
+        if lsec <= 0:
+            # Reference quirk (replicated for parity): getInertia does not
+            # reset Ixx/Iyy/Izz per iteration, so a zero-length section
+            # re-adds the PREVIOUS section's CG inertia with zero mass
+            # (raft_member.py:413-540: `if l > 0` skips the recompute but
+            # the Mmat/I accumulation below it still runs).
+            if masses:
+                masses.append(0.0)
+                ss.append(0.0)
+                Ixxs.append(Ixxs[-1])
+                Iyys.append(Iyys[-1])
+                Izzs.append(Izzs[-1])
+            vfill.append(0.0)
+            mfill.append(0.0)
+            pfill.append(0.0)
+            continue
+        l_fill = g.l_fill[i - 1] if np.ndim(g.l_fill) else g.l_fill
+        rho_fill = g.rho_fill[i - 1] if np.ndim(g.rho_fill) else g.rho_fill
+
+        if g.circular:
+            dA, dB = g.d[i - 1, 0], g.d[i, 0]
+            dAi = dA - 2 * g.t[i - 1]
+            dBi = dB - 2 * g.t[i]
+            V_o, hco = _frustum_vcv(dA, dB, lsec)
+            V_i, hci = _frustum_vcv(dAi, dBi, lsec)
+            v_shell = V_o - V_i
+            m_shell = v_shell * g.rho_shell
+            hc_shell = ((hco * V_o) - (hci * V_i)) / (V_o - V_i) if V_o != V_i else 0.0
+            dBi_fill = (dBi - dAi) * (l_fill / lsec) + dAi
+            v_fill, hc_fill = _frustum_vcv(dAi, dBi_fill, l_fill)
+            m_fill = v_fill * rho_fill
+            mass = m_shell + m_fill
+            hc = ((hc_fill * m_fill) + (hc_shell * m_shell)) / mass if mass != 0 else 0.0
+            Ir_o, Ia_o = _frustum_moi(dA, dB, lsec, g.rho_shell)
+            Ir_i, Ia_i = _frustum_moi(dAi, dBi, lsec, g.rho_shell)
+            Ir_f, Ia_f = _frustum_moi(dAi, dBi_fill, l_fill, rho_fill)
+            I_rad_end = (Ir_o - Ir_i) + Ir_f
+            I_rad = I_rad_end - mass * hc**2
+            I_ax = (Ia_o - Ia_i) + Ia_f
+            Ixx, Iyy, Izz = I_rad, I_rad, I_ax
+        else:
+            slA, slB = g.d[i - 1], g.d[i]
+            slAi = slA - 2 * g.t[i - 1]
+            slBi = slB - 2 * g.t[i]
+            V_o, hco = _frustum_vcv(slA, slB, lsec)
+            V_i, hci = _frustum_vcv(slAi, slBi, lsec)
+            v_shell = V_o - V_i
+            m_shell = v_shell * g.rho_shell
+            hc_shell = ((hco * V_o) - (hci * V_i)) / (V_o - V_i) if V_o != V_i else 0.0
+            slBi_fill = (slBi - slAi) * (l_fill / lsec) + slAi
+            v_fill, hc_fill = _frustum_vcv(slAi, slBi_fill, l_fill)
+            m_fill = v_fill * rho_fill
+            mass = m_shell + m_fill
+            hc = ((hc_fill * m_fill) + (hc_shell * m_shell)) / mass if mass != 0 else 0.0
+            Ix_o, Iy_o, Iz_o = _rect_moi(slA[0], slA[1], slB[0], slB[1], lsec, g.rho_shell)
+            Ix_i, Iy_i, Iz_i = _rect_moi(slAi[0], slAi[1], slBi[0], slBi[1], lsec, g.rho_shell)
+            Ix_f, Iy_f, Iz_f = _rect_moi(
+                slAi[0], slAi[1], slBi_fill[0], slBi_fill[1], l_fill, rho_fill
+            )
+            Ixx = (Ix_o - Ix_i) + Ix_f - mass * hc**2
+            Iyy = (Iy_o - Iy_i) + Iy_f - mass * hc**2
+            Izz = (Iz_o - Iz_i) + Iz_f
+
+        masses.append(mass)
+        ss.append(g.stations[i - 1] + hc)
+        Ixxs.append(Ixx)
+        Iyys.append(Iyy)
+        Izzs.append(Izz)
+        mshell += m_shell
+        vfill.append(float(np.ravel(v_fill)[0]) if np.ndim(v_fill) else float(v_fill))
+        mfill.append(float(m_fill))
+        pfill.append(float(rho_fill))
+
+    # ----- caps / bulkheads (shared helper) -----
+    for (m_cap, s_cg, Ixx, Iyy, Izz) in _cap_elements(g, mi):
+        masses.append(m_cap)
+        ss.append(s_cg)
+        Ixxs.append(Ixx)
+        Iyys.append(Iyy)
+        Izzs.append(Izz)
+        mshell += m_cap
 
     g.elem_mass = np.array(masses)
     g.elem_s = np.array(ss)

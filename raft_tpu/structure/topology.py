@@ -44,6 +44,7 @@ class TopoNode:
     r0: np.ndarray                 # reference position wrt PRP (3,)
     kind: str                      # 'member' | 'rotor' | 'anchor'
     owner: int = -1                # member or rotor index
+    end_node: bool = True          # False for internal beam nodes
     joint_id: int | None = None
     joint_type: str | None = None
     rigid_partner: int | None = None   # node id connected by a rigid link
@@ -60,12 +61,20 @@ class Topology:
         self.nodes: list[TopoNode] = []
         self.joints: list[dict] = []
         self._links: list[tuple[int, int]] = []
+        self._chains: list[list[int]] = []  # beam member node chains
 
     # ---------------------------------------------------------- build
-    def add_node(self, r0, kind, owner=-1):
-        n = TopoNode(id=len(self.nodes), r0=np.array(r0, dtype=float), kind=kind, owner=owner)
+    def add_node(self, r0, kind, owner=-1, end_node=True):
+        n = TopoNode(id=len(self.nodes), r0=np.array(r0, dtype=float), kind=kind,
+                     owner=owner, end_node=end_node)
         self.nodes.append(n)
         return n
+
+    def add_chain(self, node_ids):
+        """Register a flexible member's node chain: internal nodes own
+        their DOFs; traversal reaches them through the chain (the BFS
+        beam handling of raft_fowt.py:601-605)."""
+        self._chains.append(list(node_ids))
 
     def add_joint(self, r, jtype, name, tol=1e-3):
         """Create (or reuse, by name+position) a joint; raft_fowt.py:439-475."""
@@ -116,7 +125,7 @@ class Topology:
         )
 
         for n in nodes:
-            n.reducedDOF = []
+            n.reducedDOF = None
             n.T_aux = None
             n.parent = None
 
@@ -135,6 +144,7 @@ class Topology:
 
         def attach(child: TopoNode, parent: TopoNode, rigid_link: bool):
             """raft_node.py:79-159 (open-tree branches)."""
+            assert child.end_node, "only end nodes attach via joints/links"
             dofs = [list(d) for d in parent.reducedDOF]
             T2 = parent.T_aux.copy()
             jt = "rigid_link" if rigid_link else child.joint_type
@@ -160,6 +170,11 @@ class Topology:
             child.T_aux = T2[:, order]
             child.parent = parent.id
 
+        chains_by_node: dict[int, list[int]] = {}
+        for chain in self._chains:
+            for nid in chain:
+                chains_by_node[nid] = chain
+
         root.reducedDOF = [[root.id, i] for i in range(6)]
         root.T_aux = np.eye(6)
         root.parent = root.id
@@ -167,6 +182,12 @@ class Topology:
         queue = [root]
         while queue:
             node = queue.pop(0)
+            # unattached nodes reached through a beam chain get their own
+            # identity DOFs (raft_fowt.py:577-584)
+            if node.reducedDOF is None:
+                node.reducedDOF = [[node.id, i] for i in range(6)]
+                node.T_aux = np.eye(6)
+                node.parent = node.id
             for pid in links_by_node.get(node.id, []):
                 p = nodes[pid]
                 if p.id not in visited:
@@ -180,13 +201,22 @@ class Topology:
                         attach(nn, node, rigid_link=False)
                         visited.add(nn.id)
                         queue.append(nn)
+            # traverse beam chains from their end nodes
+            if node.end_node and node.id in chains_by_node:
+                for nid in chains_by_node[node.id]:
+                    if nid not in visited:
+                        visited.add(nid)
+                        queue.append(nodes[nid])
 
         if len(visited) != len(nodes):
             missing = [n.id for n in nodes if n.id not in visited]
             raise RuntimeError(f"structure not fully connected; unreached nodes {missing}")
 
+        # collect unique DOFs with the root node first (the reference
+        # moves the rigid-body node to the front of nodeList,
+        # raft_fowt.py:321-328)
         reducedDOF = []
-        for n in nodes:
+        for n in [root] + [x for x in nodes if x.id != root.id]:
             for d in n.reducedDOF:
                 if d not in reducedDOF:
                     reducedDOF.append(d)
@@ -213,7 +243,7 @@ class Topology:
         r0 = np.array([n.r0 for n in self.nodes])
         dT = np.zeros((6 * n_nodes, nDOF, nDOF))
         for i, dof in enumerate(reducedDOF):
-            if dof[1] > 2:
+            if dof[1] > 2 and self.nodes[dof[0]].end_node:
                 disp = T[:, i].reshape(n_nodes, 6)[:, :3]
                 Ti, _, _ = self.reduce(positions=r0 + disp)
                 dT[:, :, i] = Ti - T

@@ -1,0 +1,110 @@
+"""Flexible (beam-member) FOWT tests vs reference golden data
+(VolturnUS-S-flexible: FE Timoshenko pontoons + tower, joint graph with
+headings, 150 reduced DOFs).
+
+Statics, hydro constants/linearisation/current loads, static
+equilibrium and natural frequencies match at (or near) the reference's
+own tolerances.  The end-to-end dynamics PSDs agree to ~0.4%: the
+residual is the linear mean-offset kinematics used for general
+structures (the reference applies nonlinear rigid-link rotations,
+raft_fowt.py:686-752) — documented follow-up.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+from tests.conftest import ref_data
+
+import raft_tpu
+
+PATH = ref_data("VolturnUS-S-flexible.yaml")
+
+WAVE_CASE = {
+    "wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+    "turbine_status": "operating", "yaw_misalign": 0,
+    "wave_spectrum": "JONSWAP", "wave_period": 10, "wave_height": 4,
+    "wave_heading": -30, "current_speed": 0, "current_heading": 0,
+}
+X0_WAVE = [3.95574228e-01, -2.14947913e-10, -9.11283754e-01,
+           -2.56570154e-13, -2.34275902e-02, 1.24718855e-12]
+FNS_UNLOADED = [0.00841995, 0.00843999, 0.01358328, 0.0374836, 0.03753538,
+                0.04995812, 0.43542245, 0.43659318, 1.16944889, 1.43151485,
+                1.43158417, 1.55760813]
+
+
+@pytest.fixture(scope="module")
+def model():
+    if not os.path.exists(PATH):
+        pytest.skip("reference data unavailable")
+    return raft_tpu.Model(PATH)
+
+
+def test_flexible_statics(model):
+    stat = model.statics()
+    assert model.fowtList[0].nDOF == 150
+    with open(PATH.replace(".yaml", "_true_statics.pkl"), "rb") as f:
+        true = pickle.load(f)
+    for k in ["rCG", "rCG_sub", "m_ballast", "M_struc", "M_struc_sub",
+              "C_struc", "W_struc", "rCB", "C_hydro", "W_hydro"]:
+        assert_allclose(np.asarray(stat[k]), np.asarray(true[k]),
+                        rtol=1e-5, atol=1e-3, err_msg=k)
+
+
+def test_flexible_hydro(model):
+    fh = model.hydro[0]
+    with open(PATH.replace(".yaml", "_true_hydroConstants.pkl"), "rb") as f:
+        true = pickle.load(f)
+    assert_allclose(np.asarray(fh.A_hydro_morison), true["A_hydro_morison"],
+                    rtol=1e-5, atol=1e-3)
+
+    with open(PATH.replace(".yaml", "_true_hydroLinearization.pkl"), "rb") as f:
+        true = pickle.load(f)
+    fh.hydro_excitation({"wave_spectrum": "unit", "wave_heading": 0,
+                         "wave_period": 10, "wave_height": 2})
+    nDOF, nw = model.fowtList[0].nDOF, model.nw
+    phase = np.linspace(0, 2 * np.pi, nw * nDOF).reshape(nDOF, nw)
+    out = fh.hydro_linearization(0.1 * np.exp(1j * phase), ih=0)
+    assert_allclose(np.asarray(out["B_hydro_drag"]), true["B_hydro_drag"],
+                    rtol=1e-5, atol=1e-10)
+    assert_allclose(np.asarray(out["F_hydro_drag"]), true["F_hydro_drag"], rtol=1e-5)
+
+    with open(PATH.replace(".yaml", "_true_calcCurrentLoads.pkl"), "rb") as f:
+        true = pickle.load(f)
+    D = fh.current_loads({"current_speed": 2.0, "current_heading": 15})
+    assert_allclose(np.asarray(D), true, rtol=1e-5, atol=1e-3)
+
+
+def test_flexible_statics_solve(model):
+    X = np.asarray(model.solve_statics(WAVE_CASE))
+    assert_allclose(X[:6], X0_WAVE, rtol=1e-5, atol=1e-8)
+
+
+def test_flexible_eigen(model):
+    model.solve_statics(dict(WAVE_CASE, turbine_status="idle",
+                             wave_height=0, wave_period=0))
+    fns, modes = model.solve_eigen()
+    # slightly wider than the reference's rtol: the equilibrium iterate
+    # difference shifts the mooring tangent by O(1e-5) relative
+    assert_allclose(fns[:12], FNS_UNLOADED, rtol=5e-5, atol=1e-6)
+
+
+def test_flexible_dynamics(model):
+    case = dict(zip(model.design["cases"]["keys"], model.design["cases"]["data"][0]))
+    assert case["wind_speed"] == 0
+    X0 = model.solve_statics(case)
+    Xi, info = model.solve_dynamics(case, X0=X0)
+    from raft_tpu.models.outputs import turbine_outputs
+
+    metrics = turbine_outputs(model, case, X0, Xi, info["S"], info["zeta"])
+    with open(PATH.replace(".yaml", "_true_analyzeCases.pkl"), "rb") as f:
+        true = pickle.load(f)
+    tm = true["case_metrics"][0][0]
+    for name in ("surge", "heave", "pitch", "yaw"):
+        a = np.asarray(metrics[f"{name}_PSD"])
+        b = np.asarray(tm[f"{name}_PSD"])
+        # ~0.4% agreement (linear vs nonlinear mean-offset kinematics)
+        assert np.max(np.abs(a - b) / (np.abs(b) + 1e-6)) < 5e-3, name
