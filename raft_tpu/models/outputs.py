@@ -135,9 +135,39 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
             continue
         mem_tower = fs.members[tower_idx[ir]]
         if mem_tower.mtype != "rigid":
-            # flexible towers report FE internal base loads (Fbase/Mbase
-            # components, raft_fowt.py:2541-2604) — pending milestone;
-            # Mbase_* stays zero as in the reference's rigid-only branch
+            # flexible towers: internal loads at the base node from the
+            # FE stiffness matrix (raft_fowt.py:2541-2604); Mbase_* is
+            # aliased to the fore-aft component MbaseY (:2599-2604)
+            from raft_tpu.physics.beams import fe_stiffness
+
+            n0 = int(fs.member_node[tower_idx[ir]])
+            nn = mem_tower.ns
+            r_tow = np.asarray(model.hydro[ifowt].r_nodes)[n0:n0 + nn]
+            Kf = fe_stiffness(mem_tower, r_tow)
+            Tn_tow = np.asarray(model.hydro[ifowt].Tn)[n0:n0 + nn].reshape(6 * nn, -1)
+            Xi0_int = Tn_tow @ np.asarray(X0)
+            Xi_int = np.einsum("fa,haw->hfw", Tn_tow, np.asarray(Xi))
+            Fi0 = -Kf @ Xi0_int
+            Fi = -np.einsum("fe,hew->hfw", Kf, Xi_int)
+            base = slice(0, 6) if r_tow[0, 2] <= r_tow[-1, 2] else slice(6 * nn - 6, 6 * nn)
+            Fi0_b = Fi0[base]
+            Fi_b = Fi[:, base, :]
+            names = ["FbaseX", "FbaseY", "FbaseZ", "MbaseX", "MbaseY", "MbaseZ"]
+            for c, nm in enumerate(names):
+                std = float(get_rms(Fi_b[:, c, :]))
+                results.setdefault(f"{nm}_avg", np.zeros(nrot))
+                results.setdefault(f"{nm}_std", np.zeros(nrot))
+                results.setdefault(f"{nm}_PSD", np.zeros((model.nw, nrot)))
+                results.setdefault(f"{nm}_max", np.zeros(nrot))
+                results.setdefault(f"{nm}_min", np.zeros(nrot))
+                results[f"{nm}_avg"][ir] = Fi0_b[c]
+                results[f"{nm}_std"][ir] = std
+                results[f"{nm}_PSD"][:, ir] = np.asarray(get_psd(Fi_b[:, c, :], dw, axis=0))
+                results[f"{nm}_max"][ir] = Fi0_b[c] + 3 * std
+                results[f"{nm}_min"][ir] = Fi0_b[c] - 3 * std
+            for suf in ("avg", "std", "max", "min"):
+                results[f"Mbase_{suf}"][ir] = results[f"MbaseY_{suf}"][ir]
+            results["Mbase_PSD"][:, ir] = results["MbaseY_PSD"][:, ir]
             continue
         mtower = float(stat["mtower"][ir])
         rCG_tow = np.asarray(stat["rCG_tow"][ir])
