@@ -75,3 +75,50 @@ def power_thrust_curve(model, speeds, ifowt=0, ir=0):
         speeds=np.asarray(speeds), thrust=np.asarray(T), torque=np.asarray(Q),
         power=np.asarray(P), Omega_rpm=np.asarray(Om), pitch_deg=np.asarray(pit),
     )
+
+
+def adjust_ballast(base_design, target_heave=0.0, heave_tol=0.05, max_iter=12):
+    """Tune ballast fill levels to reach a target unloaded mean heave.
+
+    Equivalent of Model.adjustBallast (raft_model.py:1633-1770): secant
+    iteration on a global scale factor applied to every ballasted
+    section's fill length, re-solving the unloaded equilibrium each
+    step.  Returns (model, scale) with the adjusted design built in.
+    """
+    import copy
+
+    import numpy as np
+
+    import raft_tpu
+    from raft_tpu.structure.schema import load_design
+
+    base = load_design(base_design)
+
+    def heave_at(scale):
+        d = copy.deepcopy(base)
+        members = d["platform"]["members"]
+        for mi in members:
+            if "l_fill" in mi and np.any(np.asarray(mi["l_fill"], dtype=float) > 0):
+                lf = np.atleast_1d(np.asarray(mi["l_fill"], dtype=float)) * scale
+                st = np.asarray(mi["stations"], dtype=float)
+                lf = np.minimum(lf, np.diff(st))  # can't overfill a section
+                mi["l_fill"] = lf.tolist() if lf.size > 1 else float(lf[0])
+        model = raft_tpu.Model(d)
+        X = np.asarray(model.solve_statics(None))
+        return float(X[2]), model
+
+    s0, s1 = 1.0, 1.05
+    h0, model = heave_at(s0)
+    if abs(h0 - target_heave) < heave_tol:
+        return model, s0
+    h1, model = heave_at(s1)
+    for _ in range(max_iter):
+        if abs(h1 - h0) < 1e-12:
+            break
+        s2 = s1 - (h1 - target_heave) * (s1 - s0) / (h1 - h0)
+        s2 = float(np.clip(s2, 0.0, 3.0))
+        h2, model = heave_at(s2)
+        s0, h0, s1, h1 = s1, h1, s2, h2
+        if abs(h1 - target_heave) < heave_tol:
+            break
+    return model, s1

@@ -255,12 +255,20 @@ class FOWTStructure:
 
         # rotor-to-tower joints (raft_fowt.py:303-312)
         tower_member_idx = [i for i, m in enumerate(self.members) if m.part_of == "tower"]
+        nacelle_member_idx = [i for i, m in enumerate(self.members) if m.part_of == "nacelle"]
         for ir, rot in enumerate(self.rotors):
             joint = topo.add_joint(rot.r_rel, "cantilever", "tower2rotor")
             topo.attach_node_to_joint(
                 self._closest_end_node(topo, member_nodes, tower_member_idx[ir], joint),
                 joint)
             topo.attach_node_to_joint(topo.nodes[rotor_nodes[ir]], joint)
+            # nacelle members ride the tower top (the reference leaves
+            # them unjoined, which breaks its own DOF reduction on the
+            # MHK designs; rigid attachment to the RNA joint is the
+            # physically intended configuration)
+            if ir < len(nacelle_member_idx):
+                topo.attach_node_to_joint(
+                    topo.nodes[member_nodes[nacelle_member_idx[ir]]], joint)
 
         T, dT, reducedDOF, root_id = topo.reduce_with_derivative()
         self.topology = topo

@@ -262,7 +262,9 @@ class Model:
                            default=coerce(site, "shearExp", default=0.12)),
                 )
                 for ir in range(self.fowtList[0].nrotors):
-                    self._rotor_aero.append(build_rotor_aero(t, ir))
+                    submerged = self.fowtList[0].rotors[ir].Zhub < 0
+                    self._rotor_aero.append(
+                        build_rotor_aero(t, ir, submerged=submerged))
         return self._rotor_aero
 
     def turbine_constants(self, case, ifowt=0):
@@ -297,10 +299,14 @@ class Model:
         fh = self.hydro[ifowt]
         for ir, rot in enumerate(self.rotor_aero):
             rprops = fs.rotors[ir]
-            speed = float(coerce(case, "wind_speed", shape=0, default=10))
+            current = rprops.Zhub < 0  # submerged rotor -> current-driven
+            if current:
+                speed = float(coerce(case, "current_speed", shape=0, default=1.0))
+            else:
+                speed = float(coerce(case, "wind_speed", shape=0, default=10))
             if rprops.aeroServoMod <= 0 or speed <= 0:
                 continue
-            f0, f, a, b, info = calc_aero(rot, rprops, case, self.w)
+            f0, f, a, b, info = calc_aero(rot, rprops, case, self.w, current=current)
             node = int(fs.rotor_node[ir])
             Tn = np.asarray(fh.Tn[node])  # (6, nDOF)
             out["f_aero0"][:, ir] = Tn.T @ f0

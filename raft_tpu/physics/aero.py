@@ -84,7 +84,7 @@ class RotorAeroModel:
     I_drivetrain: float = 0.0
 
 
-def build_rotor_aero(turbine, ir=0):
+def build_rotor_aero(turbine, ir=0, submerged=False):
     """Parse the turbine dict into a RotorAeroModel.
 
     Mirrors the airfoil/station processing of Rotor.__init__
@@ -171,11 +171,20 @@ def build_rotor_aero(turbine, ir=0):
     Om = np.r_[Om, 0, 0]
     pit = np.r_[pit, 90, 90]
 
+    # submerged (MHK) rotors use water properties (raft_rotor.py:338-345)
+    if submerged:
+        rho_fl = float(turbine.get("rho_water", 1025.0))
+        mu_fl = float(turbine.get("mu_water", 1.0e-3))
+        shear_fl = float(turbine.get("shearExp_water", 0.12))
+    else:
+        rho_fl = float(turbine.get("rho_air", 1.225))
+        mu_fl = float(turbine.get("mu_air", 1.81e-5))
+        shear_fl = float(turbine.get("shearExp_air", 0.12))
     model = RotorAeroModel(
         B=nBlades, Rhub=Rhub, Rtip=Rtip, precone=precone, shaft_tilt=shaft_tilt,
-        rho=float(turbine.get("rho_air", 1.225)),
-        mu=float(turbine.get("mu_air", 1.81e-5)),
-        shearExp=float(turbine.get("shearExp_air", 0.12)),
+        rho=rho_fl,
+        mu=mu_fl,
+        shearExp=shear_fl,
         hubHt=float(hubHt), nSector=nSector,
         r=blade_r, chord=chord, theta_deg=theta,
         precurve=precurve, presweep=presweep,
@@ -435,7 +444,7 @@ def operating_point(rot: RotorAeroModel, Uhub):
 # ------------------------------------------------------------- calc aero
 
 def calc_aero(rot: RotorAeroModel, rprops, case, w, speed=None,
-              platform_heading=0.0):
+              platform_heading=0.0, current=False):
     """Aero-servo coefficients about the rotor node in global frame.
 
     Equivalent of Rotor.calcAero (raft_rotor.py:806-1028) for
@@ -451,9 +460,14 @@ def calc_aero(rot: RotorAeroModel, rprops, case, w, speed=None,
 
     w = np.asarray(w)
     nw = len(w)
-    if speed is None:
-        speed = float(coerce(case, "wind_speed", shape=0, default=10))
-    heading = float(coerce(case, "wind_heading", shape=0, default=0.0))
+    if current:  # submerged (MHK) rotor driven by the current
+        if speed is None:
+            speed = float(coerce(case, "current_speed", shape=0, default=1.0))
+        heading = float(coerce(case, "current_heading", shape=0, default=0.0))
+    else:
+        if speed is None:
+            speed = float(coerce(case, "wind_speed", shape=0, default=10))
+        heading = float(coerce(case, "wind_heading", shape=0, default=0.0))
     yaw_command = float(coerce(case, "yaw_misalign", shape=0, default=0.0))
     turbine_heading = float(coerce(case, "turbine_heading", shape=0, default=0.0))
     yaw_mode = getattr(rprops, "yaw_mode", 0)
@@ -495,8 +509,8 @@ def calc_aero(rot: RotorAeroModel, rprops, case, w, speed=None,
     f0[:3] = R_q @ loads[:3]
     f0[3:] = R_q @ loads[3:]
 
-    # rotor-averaged turbulence -> wind amplitude spectrum
-    turbulence = case.get("turbulence", 0.0)
+    # rotor-averaged turbulence -> inflow amplitude spectrum
+    turbulence = case.get("current_turbulence", 0.0) if current else case.get("turbulence", 0.0)
     hubHt = rprops.Zhub
     S_rot = kaimal_rot_psd(w, speed, turbulence, hubHt, rot.Rtip)
     V_w = np.sqrt(2 * S_rot * (w[1] - w[0])).astype(complex)

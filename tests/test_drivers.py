@@ -65,3 +65,15 @@ def test_wnd_writer(tmp_path):
     write_wnd(p, (t, eog["V"], z, z, z, z + 0.2, z, eog["V_gust"], z),
               header_lines=["! EOG"])
     assert p.read_text().startswith("! EOG")
+
+
+def test_adjust_ballast():
+    from raft_tpu.drivers import adjust_ballast
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "raft_tpu", "designs", "spar_demo.yaml")
+    model, scale = adjust_ballast(path, target_heave=0.0, heave_tol=0.05)
+    X = np.asarray(model.solve_statics(None))
+    assert abs(X[2]) < 0.05
+    assert scale != 1.0

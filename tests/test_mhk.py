@@ -1,0 +1,45 @@
+"""MHK (underwater-rotor) design smoke tests: the RM1 floating tidal
+turbine builds, reaches a current-loaded equilibrium, and solves
+dynamics with the current-driven rotor providing mean thrust."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import REFERENCE_DIR
+
+import raft_tpu
+
+PATH = os.path.join(REFERENCE_DIR, "designs", "RM1_Floating.yaml")
+
+
+@pytest.fixture(scope="module")
+def model():
+    if not os.path.exists(PATH):
+        pytest.skip("reference design unavailable")
+    return raft_tpu.Model(PATH)
+
+
+def test_mhk_builds(model):
+    fs = model.fowtList[0]
+    assert fs.nrotors == 1
+    assert fs.rotors[0].Zhub < 0  # submerged rotor
+
+
+def test_mhk_current_equilibrium(model):
+    case = dict(zip(model.design["cases"]["keys"], model.design["cases"]["data"][0]))
+    assert case["current_speed"] > 0
+    X = np.asarray(model.solve_statics(case))
+    # current thrust pushes the platform downstream
+    assert 0.5 < X[0] < 30.0
+    assert np.all(np.isfinite(X))
+    # rotor thrust from the water flow is substantial
+    F = np.asarray(model.aero_mean_force(case, 0))
+    assert F[0] > 1e4
+
+
+def test_mhk_dynamics(model):
+    case = dict(zip(model.design["cases"]["keys"], model.design["cases"]["data"][0]))
+    Xi, info = model.solve_dynamics(case)
+    assert np.isfinite(np.asarray(Xi)).all()
