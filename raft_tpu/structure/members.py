@@ -184,7 +184,9 @@ def build_member(mi, heading=0.0, part_of="platform", global_dlsMax=5.0):
 
     Mirrors Member.__init__ (raft_member.py:17-310) minus runtime state.
     """
-    mtype = str(mi.get("type", "rigid"))
+    # normalise the member type: the current schema uses 'rigid'/'beam';
+    # legacy designs carry numeric type codes (all rigid)
+    mtype = "beam" if str(mi.get("type", "rigid")).lower() == "beam" else "rigid"
     rA0 = np.array(mi["rA"], dtype=float)
     rB0 = np.array(mi["rB"], dtype=float)
     shape = str(mi["shape"])
