@@ -104,8 +104,24 @@ class Model:
             self.fowtList.append(FOWTStructure(design, depth=self.depth))
             fs = self.fowtList[0]
             if "mooring" in design and isinstance(design["mooring"], dict):
-                self.ms_list.append(
-                    build_mooring(design["mooring"], rho_water=fs.rho_water, g=fs.g))
+                mo = design["mooring"]
+                if "file" in mo and "lines" not in mo:
+                    # MoorDyn-file mooring (e.g. lumped-mass examples):
+                    # quasi-static network treatment (moorMod dynamic
+                    # matrices are a follow-up milestone)
+                    import os
+
+                    from raft_tpu.physics.mooring import parse_moordyn
+
+                    fpath = mo["file"]
+                    if self.base_dir is not None and not os.path.isabs(fpath):
+                        fpath = os.path.join(self.base_dir, fpath)
+                    self.ms_list.append(parse_moordyn(
+                        fpath, coerce(mo, "water_depth", default=self.depth),
+                        rho=fs.rho_water, g=fs.g))
+                else:
+                    self.ms_list.append(
+                        build_mooring(mo, rho_water=fs.rho_water, g=fs.g))
             else:
                 self.ms_list.append(None)
 

@@ -193,9 +193,15 @@ def solve_catenary(XF, ZF, L, w, EA, n_iter=60, can_ground=True):
 
 # ------------------------------------------------------------ body level
 
-def mooring_force(ms: MooringSystem, r6):
+def mooring_force(ms, r6):
     """Net 6-DOF mooring force on the body at pose ``r6`` about the body
-    origin (line forces only)."""
+    origin (line forces only).  Accepts a MooringSystem or a one-body
+    MooringNetwork (MoorDyn-file moorings with free points)."""
+    if isinstance(ms, MooringNetwork):
+        F, info = ms.body_forces(jnp.asarray(r6)[None, :])
+        t = info["tensions"]  # (nL, 2) anchor/fairlead magnitudes
+        return F[0], dict(HF=t[:, 1], VF=jnp.zeros_like(t[:, 1]),
+                          HA=t[:, 0], VA=jnp.zeros_like(t[:, 0]))
     R = tf.rotation_matrix(r6[3], r6[4], r6[5])
     r_fair = r6[:3] + jnp.asarray(ms.r_fair0) @ R.T  # (nL, 3)
     dvec = r_fair - jnp.asarray(ms.r_anchor)
@@ -212,9 +218,11 @@ def mooring_force(ms: MooringSystem, r6):
     return jnp.sum(F6, axis=0), dict(HF=HF, VF=VF, HA=HA, VA=VA)
 
 
-def mooring_stiffness(ms: MooringSystem, r6):
+def mooring_stiffness(ms, r6):
     """Coupled 6x6 mooring stiffness C = -dF/dr6 at pose r6 (exact
     Jacobian; MoorPy getCoupledStiffnessA equivalent)."""
+    if isinstance(ms, MooringNetwork):
+        return ms.stiffness(jnp.asarray(r6)[None, :])
     f = lambda x: mooring_force(ms, x)[0]
     return -jax.jacfwd(f)(jnp.asarray(r6, dtype=float))
 
