@@ -1,21 +1,23 @@
-"""Benchmark: frequency-domain design evaluations per second per chip.
+"""Benchmark: full design evaluations per second per chip on the
+north-star workload (BASELINE.md): the IEA-15MW VolturnUS-S semi at
+100 frequency bins x 12 load cases with an OPERATING TURBINE — i.e.
+each case evaluation includes BEMT aero-servo constants, mean
+thrust/current in the equilibrium, strip-theory excitation, iterative
+stochastic drag linearisation and the per-frequency 6-DOF complex
+impedance solves (the chain of raft_model.py:966-1255).
 
-Workload (the reference's headline loop, SURVEY.md §6 / BASELINE.md):
-one full design evaluation = static equilibrium (catenary mooring
-Newton) + strip-theory wave excitation + iterative stochastic drag
-linearisation + per-frequency 6-DOF complex impedance solves + response
-spectra, on a spar design with ~80 Morison strips x 40 frequencies and
-10 linearisation iterations.
+* raft_tpu path: ``api.make_full_evaluator`` — the whole chain as one
+  jit — vmapped over (designs x cases) on this chip.
+* baseline: a serial NumPy twin of the same math structured the way the
+  reference is (per-strip/per-frequency Python loops,
+  raft_model.py:1084-1089, raft_member.py:1965-2124), with rotor aero
+  from a serial blade-element solve (scipy brentq per element, central
+  finite differences for the load derivatives).  Measured here because
+  the reference publishes no numbers and cannot run in this image (its
+  moorpy/ccblade deps are absent; see BASELINE.md).
 
-* raft_tpu path: the jitted, vmapped evaluator from raft_tpu.api,
-  batched over sea states (the per-chip shard of a design sweep).
-* baseline: a straight serial NumPy implementation of the same math,
-  looping members/strips and frequencies the way the reference does
-  (raft_model.py:1084-1089, raft_member.py:1965-2124) — measured here
-  because the reference itself publishes no numbers and cannot run in
-  this image (its moorpy/ccblade deps are absent; see BASELINE.md).
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+One design evaluation = the full 12-case table.  Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
@@ -24,20 +26,224 @@ import time
 
 import numpy as np
 
+VOLTURN = "/root/reference/examples/VolturnUS-S_example.yaml"
+
+# 12-case table: operating turbine across the schedule, varied seas
+CASES = [
+    # (wind_speed, wind_heading, TI, Hs, Tp, wave_heading)
+    (4.0, 0.0, 0.12, 1.5, 7.0, 0.0),
+    (6.0, 0.0, 0.12, 1.8, 7.5, 0.0),
+    (8.0, 10.0, 0.12, 2.2, 8.0, 10.0),
+    (10.0, 0.0, 0.14, 2.8, 9.0, 0.0),
+    (10.6, 0.0, 0.14, 3.0, 9.5, 20.0),
+    (12.0, -10.0, 0.14, 3.4, 10.0, 0.0),
+    (14.0, 0.0, 0.14, 4.0, 10.5, 0.0),
+    (16.0, 0.0, 0.16, 4.6, 11.0, 30.0),
+    (18.0, 20.0, 0.16, 5.2, 11.5, 0.0),
+    (20.0, 0.0, 0.16, 5.8, 12.0, 0.0),
+    (22.0, 0.0, 0.16, 6.5, 12.5, -20.0),
+    (24.0, 0.0, 0.18, 7.2, 13.0, 0.0),
+]
+
 
 def build():
     import raft_tpu
-    from raft_tpu.api import make_case_evaluator
+    from raft_tpu.api import make_full_evaluator
+    from raft_tpu.structure.schema import load_design
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    model = raft_tpu.Model(os.path.join(here, "raft_tpu", "designs", "spar_demo.yaml"))
-    return model, make_case_evaluator(model)
+    design = load_design(VOLTURN)
+    design["settings"]["min_freq"] = 0.002   # 100 w-bins (0.002..0.2 Hz)
+    design["settings"]["max_freq"] = 0.2
+    model = raft_tpu.Model(design)
+    assert model.nw == 100
+    return model, make_full_evaluator(model)
 
 
-# --------------------------------------------------------------- baseline
+# ---------------------------------------------------- NumPy baseline: aero
 
-def numpy_eval_case(model, Hs, Tp, beta):
-    """Serial NumPy twin of one design evaluation (reference-style loops)."""
+def _np_solve_phi(Vx, Vy, sigma_p, theta, lc_tip, lc_hub, cl_tab, cd_tab,
+                  aoa_rad):
+    """Serial inflow-angle solve (Ning 2014 residual, brentq bracket)."""
+    from scipy.optimize import brentq
+
+    def induction(phi):
+        sphi, cphi = np.sin(phi), np.cos(phi)
+        sphi_s = np.sign(sphi) * max(abs(sphi), 1e-9) if sphi != 0 else 1e-9
+        alpha = phi - theta
+        cl = np.interp(alpha, aoa_rad, cl_tab)
+        cd = np.interp(alpha, aoa_rad, cd_tab)
+        cn = cl * cphi + cd * sphi
+        ct = cl * sphi - cd * cphi
+        Ftip = 2 / np.pi * np.arccos(np.clip(np.exp(-lc_tip / abs(sphi_s)), 0, 1))
+        Fhub = 2 / np.pi * np.arccos(np.clip(np.exp(-lc_hub / abs(sphi_s)), 0, 1))
+        F = max(Ftip * Fhub, 1e-6)
+        kk = sigma_p * cn / (4.0 * F * sphi_s**2)
+        kp = sigma_p * ct / (4.0 * F * sphi_s * cphi)
+        g1 = 2 * F * kk - (10.0 / 9 - F)
+        g2 = max(2 * F * kk - F * (4.0 / 3 - F), 1e-12)
+        g3 = 2 * F * kk - (25.0 / 9 - 2 * F)
+        if phi > 0:
+            if kk <= 2.0 / 3:
+                a = kk / (1.0 + kk) if abs(1 + kk) > 1e-12 else 0.0
+            elif abs(g3) < 1e-6:
+                a = 1.0 - 1.0 / (2.0 * np.sqrt(g2))
+            else:
+                a = (g1 - np.sqrt(g2)) / g3
+        else:
+            a = kk / (kk - 1.0) if kk > 1.0 else 0.0
+        ap = kp / (1.0 - kp) if abs(1 - kp) > 1e-12 else 0.0
+        return a, ap
+
+    def residual(phi):
+        a, ap = induction(phi)
+        sphi, cphi = np.sin(phi), np.cos(phi)
+        return sphi / max(1.0 - a, 1e-12) - Vx / Vy * cphi / max(1.0 + ap, 1e-12)
+
+    eps = 1e-6
+    try:
+        if residual(eps) * residual(np.pi / 2) <= 0:
+            phi = brentq(residual, eps, np.pi / 2, xtol=1e-10)
+        else:
+            phi = brentq(residual, np.pi / 2, np.pi - eps, xtol=1e-10)
+    except ValueError:
+        phi = eps
+    a, ap = induction(phi)
+    return phi, a, ap
+
+
+def numpy_rotor_loads(rm, Uinf, Om_rpm, pitch_deg, tilt, yaw):
+    """Azimuthally averaged hub loads, serial loops (baseline twin of
+    the traced BEMT in raft_tpu.physics.aero)."""
+    from raft_tpu.physics.aero import _curvature
+
+    x_az, y_az, z_az, cone, _ = _curvature(rm.r, rm.precurve, rm.presweep, rm.precone)
+    rfull = np.r_[rm.Rhub, rm.r, rm.Rtip]
+    cvfull = np.r_[0.0, rm.precurve, rm.precurveTip]
+    swfull = np.r_[0.0, rm.presweep, rm.presweepTip]
+    xf, yf, zf, conef, sf = _curvature(rfull, cvfull, swfull, rm.precone)
+
+    Omega = Om_rpm * np.pi / 30.0
+    theta_r = np.deg2rad(rm.theta_deg + pitch_deg)
+    sigma_p = rm.B * rm.chord / (2.0 * np.pi * rm.r)
+    lc_tip = rm.B / 2.0 * (rm.Rtip - rm.r) / rm.r
+    lc_hub = rm.B / 2.0 * (rm.r - rm.Rhub) / rm.Rhub
+    aoa_rad = np.deg2rad(rm.aoa_deg)
+    nr = len(rm.r)
+
+    F_sum = np.zeros(3)
+    M_sum = np.zeros(3)
+    for isec in range(rm.nSector):
+        az = isec * 2 * np.pi / rm.nSector
+        sy, cy = np.sin(yaw), np.cos(yaw)
+        st, ct = np.sin(tilt), np.cos(tilt)
+        sa, ca = np.sin(az), np.cos(az)
+        sc, cc = np.sin(cone), np.cos(cone)
+        height = (y_az * sa + z_az * ca) * ct - x_az * st
+        V = Uinf * (1.0 + height / rm.hubHt) ** rm.shearExp
+        Vx = V * ((cy * st * ca + sy * sa) * sc + cy * ct * cc) - Omega * y_az * sc
+        Vy = V * (cy * st * sa - sy * ca) + Omega * z_az
+
+        Np = np.zeros(nr)
+        Tp = np.zeros(nr)
+        for ie in range(nr):  # serial element loop, as CCBlade does
+            phi, a, ap = _np_solve_phi(
+                Vx[ie], Vy[ie], sigma_p[ie], theta_r[ie], lc_tip[ie],
+                lc_hub[ie], rm.cl[ie], rm.cd[ie], aoa_rad)
+            sphi, cphi = np.sin(phi), np.cos(phi)
+            alpha = phi - theta_r[ie]
+            cl = np.interp(alpha, aoa_rad, rm.cl[ie])
+            cd = np.interp(alpha, aoa_rad, rm.cd[ie])
+            cn = cl * cphi + cd * sphi
+            ctv = cl * sphi - cd * cphi
+            W2 = (Vx[ie] * (1 - a)) ** 2 + (Vy[ie] * (1 + ap)) ** 2
+            qd = 0.5 * rm.rho * W2 * rm.chord[ie]
+            Np[ie] = cn * qd
+            Tp[ie] = ctv * qd
+
+        Npf = np.r_[0.0, Np, 0.0]
+        Tpf = np.r_[0.0, Tp, 0.0]
+        fx = Npf * np.cos(conef)
+        fy = Tpf
+        fz = Npf * np.sin(conef)
+        Fx, Fy, Fz = (np.trapezoid(v, sf) for v in (fx, fy, fz))
+        mx = yf * fz - zf * fy
+        my = zf * fx - xf * fz
+        mz = xf * fy - yf * fx
+        Mx, My, Mz = (np.trapezoid(v, sf) for v in (mx, my, mz))
+        F_sum += [Fx, ca * Fy - sa * Fz, sa * Fy + ca * Fz]
+        M_sum += [Mx, ca * My - sa * Mz, sa * My + ca * Mz]
+
+    F = rm.B * F_sum / rm.nSector
+    M = rm.B * M_sum / rm.nSector
+    return np.array([F[0], F[1], F[2], -M[0], M[1], M[2]])
+
+
+def numpy_turbine_constants(model, case, w):
+    """Mean rotor force + aero damping/added-mass + gyroscopics
+    (baseline twin of FOWT.calcTurbineConstants with serial BEMT and
+    finite-difference load derivatives)."""
+    from raft_tpu.physics.aero import RPM2RADPS, kaimal_rot_psd
+
+    fs = model.fowtList[0]
+    nw = len(w)
+    out = dict(f0=np.zeros(6), A=np.zeros((6, 6, nw)), B=np.zeros((6, 6, nw)),
+               B_gyro=np.zeros((6, 6)))
+    speed = float(case.get("wind_speed", 0.0))
+    if not model.rotor_aero or speed <= 0:
+        return out
+    for ir, rm in enumerate(model.rotor_aero):
+        rp = fs.rotors[ir]
+        heading = np.radians(float(case.get("wind_heading", 0.0)))
+        yaw = heading + np.radians(float(case.get("yaw_misalign", 0.0)))
+        R_q = _rotmat(0.0, -rp.shaft_tilt, rp.shaft_toe + yaw)
+        q = R_q @ np.array([1.0, 0.0, 0.0])
+        yaw_mis = np.arctan2(q[1], q[0]) - heading
+        tilt = np.arctan2(q[2], np.hypot(q[0], q[1]))
+        Om = np.interp(speed, rm.U_sched, rm.Omega_sched)
+        pit = np.interp(speed, rm.U_sched, rm.pitch_sched)
+
+        loads = numpy_rotor_loads(rm, speed, Om, pit, -tilt, yaw_mis)
+        # central finite differences for the load derivatives
+        dU, dOm, dPi = 0.1, 0.05, 0.05
+        dT_dU = (numpy_rotor_loads(rm, speed + dU, Om, pit, -tilt, yaw_mis)[0]
+                 - numpy_rotor_loads(rm, speed - dU, Om, pit, -tilt, yaw_mis)[0]) / (2 * dU)
+
+        f0 = np.zeros(6)
+        f0[:3] = R_q @ loads[:3]
+        f0[3:] = R_q @ loads[3:]
+        r_off = q * rp.overhang
+        f0[3:] += np.cross(r_off, f0[:3])
+        out["f0"] += f0
+
+        # aeroServoMod 1: fore-aft damping dT/dU only (raft_rotor.py:880-900)
+        qq = np.outer(q, q)
+        B6 = np.zeros((6, 6))
+        B6[:3, :3] = dT_dU * qq
+        H = _skew(r_off)
+        B6t = np.zeros((6, 6))
+        B6t[:3, :3] = B6[:3, :3]
+        B6t[:3, 3:] = B6[:3, :3] @ H
+        B6t[3:, :3] = B6t[:3, 3:].T
+        B6t[3:, 3:] = H @ B6[:3, :3] @ H.T
+        out["B"] += B6t[:, :, None]
+
+        # Kaimal spectrum (scipy special functions) for the excitation path
+        kaimal_rot_psd(w, speed, float(case.get("turbulence", 0.1)),
+                       rp.Zhub, rm.Rtip)
+
+        IO = q * (rp.I_drivetrain * Om * 2 * np.pi / 60)
+        G = np.zeros((6, 6))
+        G[3:, 3:] = _skew(IO)
+        out["B_gyro"] += G
+    return out
+
+
+# ------------------------------------------------- NumPy baseline: case
+
+def numpy_eval_case(model, case):
+    """Serial NumPy twin of one FULL case evaluation (reference-style
+    loops): turbine constants -> equilibrium -> excitation -> drag
+    linearisation -> per-frequency solves -> response spectra."""
     fs = model.fowtList[0]
     fh = model.hydro[0]
     ss = fh.strips
@@ -47,25 +253,29 @@ def numpy_eval_case(model, Hs, Tp, beta):
     dw = w[1] - w[0]
     rho, g, depth = fs.rho_water, fs.g, fs.depth
 
+    Hs = float(case["wave_height"])
+    Tp = float(case["wave_period"])
+    beta = np.radians(float(case["wave_heading"]))
+
     stat = model.statics()
     K_h = np.asarray(stat["C_struc"] + stat["C_hydro"])
     F_und = np.asarray(stat["W_struc"] + stat["W_hydro"])
-    M = np.asarray(stat["M_struc"]) + np.asarray(fh.hc0["A_hydro"])
     Imat = np.asarray(fh.hc0["Imat"])  # (S,3,3,nw)
     a_i = np.asarray(fh.hc0["a_i"])
     ms = model.ms
 
-    # --- catenary (serial per line, Newton)
-    def line_force(r6):
-        from numpy import hypot
+    # --- aero-servo constants (serial BEMT + FD derivatives)
+    tc = numpy_turbine_constants(model, case, w)
+    M = np.asarray(stat["M_struc"]) + np.asarray(fh.hc0["A_hydro"])
 
+    # --- catenary mooring (serial per line, Newton)
+    def line_force(r6):
         R = _rotmat(r6[3], r6[4], r6[5])
         F = np.zeros(6)
-        K = np.zeros((6, 6))
         for iL in range(ms.n_lines):
             rf = r6[:3] + R @ ms.r_fair0[iL]
             dv = rf - ms.r_anchor[iL]
-            XF, ZF = hypot(dv[0], dv[1]), dv[2]
+            XF, ZF = np.hypot(dv[0], dv[1]), dv[2]
             HF, VF = _catenary_np(XF, ZF, ms.L[iL], ms.w[iL], ms.EA[iL])
             uh = dv[:2] / max(XF, 1e-9)
             f3 = np.array([-HF * uh[0], -HF * uh[1], -VF])
@@ -81,18 +291,18 @@ def numpy_eval_case(model, Hs, Tp, beta):
             K[:, j] = -(line_force(r6 + e) - line_force(r6 - e)) / (2 * dx)
         return K
 
-    # --- static equilibrium (Newton, reference stopping rule)
+    # --- static equilibrium with environmental mean loads
     X = np.zeros(6)
     tols = np.array([0.05, 0.05, 0.05, 0.005, 0.005, 0.005])
     for _ in range(30):
-        F = F_und - K_h @ X + line_force(X)
+        F = F_und - K_h @ X + tc["f0"] + line_force(X)
         K = K_h + line_stiffness(X)
         dX = np.linalg.solve(K, F)
         if np.all(np.abs(dX) < tols):
             break
         X += dX
 
-    # --- strip frames at mean offset
+    # --- strip frames at the mean offset
     Rp = _rotmat(X[3], X[4], X[5])
     r0n = fs.node_r0
     d = r0n - r0n[fs.root_id]
@@ -115,11 +325,12 @@ def numpy_eval_case(model, Hs, Tp, beta):
         if not active[s]:
             continue
         F3 = np.einsum("ijw,jw->iw", Imat[s], ud) + pd[None, :] * (a_i[s] * q[s])[:, None]
-        lever = r[s] - r_nodes[ss.node[s]] + (r_nodes[ss.node[s]] - r_nodes[fs.root_id])
+        lever = r[s] - r_nodes[fs.root_id]
         Fexc[:3] += F3
         Fexc[3:] += np.cross(np.broadcast_to(lever[:, None], F3.shape), F3, axis=0)
 
     C = K_h + line_stiffness(X)
+    B_const = tc["B"] + tc["B_gyro"][:, :, None]
 
     # --- drag linearisation iterations + per-frequency solves
     a_q = np.where(ss.circ, np.pi * ss.ds[:, 0] * ss.dls, 2 * (ss.ds[:, 0] + ss.ds[:, 0]) * ss.dls)
@@ -166,7 +377,7 @@ def numpy_eval_case(model, Hs, Tp, beta):
 
         Xi = np.zeros((6, nw), dtype=complex)
         for i in range(nw):  # frequency loop, as the reference does
-            Z = -w[i] ** 2 * M + 1j * w[i] * B6 + C
+            Z = -w[i] ** 2 * M + 1j * w[i] * (B6 + B_const[:, :, i]) + C
             Xi[:, i] = np.linalg.solve(Z, Fexc[:, i] + Fdrag[:, i])
         tolCheck = np.abs(Xi - XiLast) / (np.abs(Xi) + 0.01)
         if np.all(tolCheck < 0.01):
@@ -260,35 +471,44 @@ def main():
     import jax.numpy as jnp
 
     model, evaluate = build()
+    n_cases = len(CASES)
+    arr = np.array(CASES)
 
-    # --- accelerator path: batched sweep on this chip
-    fn = jax.jit(jax.vmap(lambda h, t, b: evaluate(h, t, b)["PSD"]))
-    B = int(os.environ.get("RAFT_TPU_BENCH_BATCH", "512"))
-    rng = np.random.default_rng(0)
-    Hs = jnp.asarray(2.0 + 6.0 * rng.random(B), dtype=jnp.float32)
-    Tp = jnp.asarray(8.0 + 8.0 * rng.random(B), dtype=jnp.float32)
-    beta = jnp.asarray(2 * np.pi * rng.random(B), dtype=jnp.float32)
-    jax.block_until_ready(fn(Hs, Tp, beta))  # compile
-    reps = 5
+    def eval_case(ws, wh, ti, hs, tp, bd):
+        return evaluate(dict(wind_speed=ws, wind_heading_deg=wh, TI=ti,
+                             Hs=hs, Tp=tp, beta_deg=bd))["PSD"]
+
+    fn = jax.jit(jax.vmap(eval_case))
+
+    # batch of B designs x 12 cases, flattened (each case independent)
+    B = int(os.environ.get("RAFT_TPU_BENCH_DESIGNS", "16"))
+    reps = int(os.environ.get("RAFT_TPU_BENCH_REPS", "3"))
+    tiled = np.tile(arr, (B, 1))
+    args = [jnp.asarray(tiled[:, j], dtype=jnp.float32) for j in range(6)]
+    jax.block_until_ready(fn(*args))  # compile
     t0 = time.perf_counter()
     for _ in range(reps):
-        jax.block_until_ready(fn(Hs, Tp, beta))
+        jax.block_until_ready(fn(*args))
     dt = (time.perf_counter() - t0) / reps
-    evals_per_sec = B / dt
+    design_evals_per_sec = B / dt
 
-    # --- NumPy baseline (serial loops, reference structure)
-    n_base = 5
+    # --- NumPy baseline: serial full-case evaluations, extrapolated to
+    # the 12-case design evaluation
+    n_base = int(os.environ.get("RAFT_TPU_BENCH_NBASE", "3"))
+    cases = [dict(wind_speed=c[0], wind_heading=c[1], turbulence=c[2],
+                  wave_height=c[3], wave_period=c[4], wave_heading=c[5])
+             for c in CASES]
     t0 = time.perf_counter()
     for i in range(n_base):
-        numpy_eval_case(model, float(Hs[i]), float(Tp[i]), float(beta[i]))
-    base_dt = (time.perf_counter() - t0) / n_base
-    base_evals_per_sec = 1.0 / base_dt
+        numpy_eval_case(model, cases[i % n_cases])
+    base_case_dt = (time.perf_counter() - t0) / n_base
+    base_design_evals_per_sec = 1.0 / (n_cases * base_case_dt)
 
     print(json.dumps({
-        "metric": "design-evals/sec/chip (full freq-domain case evaluation)",
-        "value": round(evals_per_sec, 2),
-        "unit": "evals/s",
-        "vs_baseline": round(evals_per_sec / base_evals_per_sec, 2),
+        "metric": "design-evals/sec/chip (VolturnUS-S, 100w x 12 cases, operating turbine)",
+        "value": round(design_evals_per_sec, 3),
+        "unit": "design-evals/s",
+        "vs_baseline": round(design_evals_per_sec / base_design_evals_per_sec, 2),
     }))
 
 

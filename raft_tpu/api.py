@@ -121,6 +121,297 @@ def make_design_evaluator(model):
     return evaluate
 
 
+def _interp_heading_traced(X_BEM, headings, beta_deg):
+    """Traced wrap-around heading interpolation + rotation to global
+    (jax twin of :func:`raft_tpu.io.wamit.interp_heading`)."""
+    X_BEM = jnp.asarray(X_BEM)
+    h = np.asarray(headings, dtype=float)
+    ext_h = jnp.asarray(np.concatenate([[h[-1] - 360.0], h, [h[0] + 360.0]]))
+    ext_X = jnp.concatenate([X_BEM[-1:], X_BEM, X_BEM[:1]], axis=0)
+    beta = beta_deg % 360.0
+    idx = jnp.clip(jnp.searchsorted(ext_h, beta, side="right") - 1,
+                   0, len(h))
+    f = (beta - ext_h[idx]) / (ext_h[idx + 1] - ext_h[idx])
+    Xp = ext_X[idx] * (1 - f) + ext_X[idx + 1] * f  # (6, nw)
+
+    b = jnp.deg2rad(beta_deg)
+    sb, cb = jnp.sin(b), jnp.cos(b)
+    return jnp.stack([
+        Xp[0] * cb - Xp[1] * sb,
+        Xp[0] * sb + Xp[1] * cb,
+        Xp[2],
+        Xp[3] * cb - Xp[4] * sb,
+        Xp[3] * sb + Xp[4] * cb,
+        Xp[5],
+    ])
+
+
+def _qtf_model_grid(qtf_data, w):
+    """Bilinear-interpolate the QTF onto the model w x w grid per
+    heading node (build time; linear interps over independent axes
+    commute with the traced heading interpolation)."""
+    from scipy.interpolate import RegularGridInterpolator
+
+    w = np.asarray(w)
+    nw = len(w)
+    w2 = qtf_data["w_2nd"]
+    qtf = qtf_data["qtf"]  # (nw2, nw2, nh, 6)
+    nh, ndof = qtf.shape[2], qtf.shape[3]
+    pts = np.stack(np.meshgrid(w, w, indexing="ij"), axis=-1).reshape(-1, 2)
+    Qm = np.zeros((nh, nw, nw, ndof), dtype=complex)
+    for ih in range(nh):
+        for idof in range(ndof):
+            Qr = RegularGridInterpolator((w2, w2), qtf[:, :, ih, idof].real,
+                                         bounds_error=False, fill_value=0)(pts)
+            Qi = RegularGridInterpolator((w2, w2), qtf[:, :, ih, idof].imag,
+                                         bounds_error=False, fill_value=0)(pts)
+            Qm[ih, :, :, idof] = (Qr + 1j * Qi).reshape(nw, nw)
+    return Qm
+
+
+def _hydro_force_2nd_traced(Qm, heads_rad, beta, S0, dw):
+    """Traced difference-frequency force realization
+    (calcHydroForce_2ndOrd 'qtf' mode, raft_fowt.py:2218-2245).
+
+    Qm : (nh, nw, nw, 6) model-grid QTF; beta traced [rad]; S0 (nw,).
+    Returns (f_mean (6,), f (6, nw) real)."""
+    nh, nw = Qm.shape[0], Qm.shape[1]
+    heads = jnp.asarray(heads_rad)
+    if nh == 1:
+        Q = jnp.asarray(Qm)[0]
+    else:
+        b = jnp.clip(beta, heads[0], heads[-1])
+        i = jnp.clip(jnp.searchsorted(heads, b) - 1, 0, nh - 2)
+        f = (b - heads[i]) / (heads[i + 1] - heads[i])
+        Q = jnp.asarray(Qm)[i] * (1 - f) + jnp.asarray(Qm)[i + 1] * f
+
+    j = jnp.arange(nw)
+    col = j[None, :] + j[:, None]              # (mu, j) -> j + mu
+    valid = col < nw
+    colc = jnp.minimum(col, nw - 1)
+    Qd = Q[j[None, :], colc, :]                # (mu, j, 6) = Q[j, j+mu]
+    Ssh = S0[colc]
+    P = S0[None, :, None] * Ssh[:, :, None] * jnp.abs(Qd) ** 2
+    P = jnp.where(valid[:, :, None], P, 0.0)
+    f_mu = 4.0 * jnp.sqrt(jnp.sum(P, axis=1)) * dw       # (mu, 6)
+    # shift difference frequencies onto the model grid (raft_fowt.py:2241-2245)
+    f_out = jnp.concatenate([f_mu[1:], jnp.zeros((1, f_mu.shape[1]))], axis=0)
+    diagQ = Q[j, j, :].real                               # (nw, 6)
+    f_mean = 2.0 * jnp.sum(S0[:, None] * diagQ, axis=0) * dw
+    return f_mean, f_out.T
+
+
+def make_full_evaluator(model, nWaves=1, turb_static=None):
+    """Build the FULL-PHYSICS traced case evaluator for a single-FOWT
+    model: aero-servo constants + gyroscopics, potential-flow A/B/X,
+    multi-heading Morison excitation, external-QTF second-order forces,
+    current loads, equilibrium with environmental mean forces, the
+    drag-linearised impedance solve and the multi-source response — one
+    pure jax function of the load-case parameters, jit/vmap-able over
+    the (case x design) sweep axes.
+
+    This is the end-to-end jit of Model.analyzeCases' per-case chain
+    (raft_model.py:264-433, solveDynamics :966-1255) for a rigid FOWT.
+
+    ``evaluate(case)`` takes a dict of (traced) values:
+        wind_speed, wind_heading_deg, TI (turbulence intensity),
+        yaw_misalign_deg, current_speed, current_heading_deg  — scalars
+        Hs, Tp, gamma, beta_deg                               — (nWaves,)
+    and returns X0, Xi (nWaves+1, nDOF, nw), RAO, PSD, S, plus the aero
+    channel ingredients (f_aero, V_w, ...).
+
+    Static per evaluator: nWaves, spectrum type (JONSWAP), operating
+    turbine status, and the turbulence *class* (``turb_static``
+    overrides the (TurbMod, V_ref_cls) pair, default NTM/class-I).
+    """
+    fs = model.fowtList[0]
+    assert model.nFOWT == 1, "full traced evaluator covers single-FOWT models"
+    assert fs.is_single_body, "full traced evaluator covers rigid 6-DOF FOWTs"
+    ms = model.ms
+    fh = model.hydro[0]
+    ss = fh.strips
+    w = jnp.asarray(model.w)
+    k = jnp.asarray(model.k)
+    dw = model.w[1] - model.w[0]
+    nw = model.nw
+    nDOF = fs.nDOF
+
+    stat = model.statics()
+    K_h = np.asarray(stat["C_struc"] + stat["C_hydro"])
+    C_elast = np.asarray(stat["C_elast"])
+    F_und = np.asarray(stat["W_struc"] + stat["W_hydro"] + stat["f0_additional"])
+    M_struc = np.asarray(stat["M_struc"])
+    A_hydro = np.asarray(fh.hc0["A_hydro"])
+    hc0 = fh.hc0
+    # zero-pose reduction rows (N, 6, nDOF) — computed fresh, NOT taken
+    # from fh.Tn which tracks whatever pose set_position last applied
+    r0_nodes = jnp.asarray(fs.node_r0, dtype=float)
+    Tn0 = node_T(r0_nodes, r0_nodes[fs.root_id])
+
+    # potential-flow coefficients (constants on the model grid)
+    bem = model.bem
+    A_BEM = np.zeros((nDOF, nDOF, nw))
+    B_BEM = np.zeros((nDOF, nDOF, nw))
+    if bem is not None:
+        A_BEM[:6, :6, :] = bem["A_BEM"]
+        B_BEM[:6, :6, :] = bem["B_BEM"]
+    has_X = bem is not None and np.any(np.abs(bem["X_BEM"]) > 0)
+
+    # external difference-frequency QTF on the model grid
+    qtf = model.qtf
+    Qm = _qtf_model_grid(qtf, model.w) if qtf is not None else None
+
+    # rotor aero models (static schedules/polars)
+    rotor_aero = model.rotor_aero if fs.nrotors else []
+    from raft_tpu.physics.aero import calc_aero_traced, operating_point
+
+    from raft_tpu.models.statics_solve import make_tolerances
+    tol_vec, caps, refs = make_tolerances([fs])
+
+    def evaluate(case):
+        wind_speed = case.get("wind_speed", 0.0)
+        wind_heading = case.get("wind_heading_deg", 0.0)
+        TI = case.get("TI", 0.0)
+        yaw_cmd = jnp.deg2rad(case.get("yaw_misalign_deg", 0.0))
+        cur_speed = case.get("current_speed", 0.0)
+        cur_heading = case.get("current_heading_deg", 0.0)
+        Hs = jnp.atleast_1d(jnp.asarray(case["Hs"], dtype=float))
+        Tp = jnp.atleast_1d(jnp.asarray(case["Tp"], dtype=float))
+        gamma = jnp.atleast_1d(jnp.asarray(case.get("gamma", 0.0)) * jnp.ones(nWaves))
+        beta_deg = jnp.atleast_1d(jnp.asarray(case.get("beta_deg", 0.0)) * jnp.ones(nWaves))
+        beta = jnp.deg2rad(beta_deg)
+
+        # ---- aero-servo constants about the rotor nodes (zero-pose Tn,
+        # matching the reference's calcTurbineConstants-at-case-start)
+        f_aero0 = jnp.zeros(nDOF)
+        f_aero = jnp.zeros((nDOF, nw), dtype=complex)
+        A_aero = jnp.zeros((nDOF, nDOF, nw))
+        B_aero = jnp.zeros((nDOF, nDOF, nw))
+        B_gyro = jnp.zeros((nDOF, nDOF))
+        A00 = jnp.zeros((nw, max(fs.nrotors, 1)))
+        B00 = jnp.zeros((nw, max(fs.nrotors, 1)))
+        Om_out = jnp.zeros(max(fs.nrotors, 1))
+        pitch_out = jnp.zeros(max(fs.nrotors, 1))
+        for ir, rot in enumerate(rotor_aero):
+            rprops = fs.rotors[ir]
+            if rprops.aeroServoMod <= 0:
+                continue
+            current = rprops.Zhub < 0
+            speed = cur_speed if current else wind_speed
+            heading = jnp.deg2rad(cur_heading if current else wind_heading)
+            ts = turb_static or ("NTM", 50.0)
+            on = speed > 0
+            speed_safe = jnp.maximum(speed, 0.1)
+            f0, f6, a6, b6, Bg, qv = calc_aero_traced(
+                rot, rprops, w, speed_safe, heading, TI, yaw_command_rad=yaw_cmd,
+                turb_static=ts)
+            node = int(fs.rotor_node[ir])
+            Tn = Tn0[node]  # (6, nDOF)
+            f_aero0 = f_aero0 + on * (Tn.T @ f0)
+            f_aero = f_aero + on * (Tn.T @ f6)
+            A_aero = A_aero + on * jnp.einsum("ia,ijw,jb->abw", Tn, a6, Tn)
+            B_aero = B_aero + on * jnp.einsum("ia,ijw,jb->abw", Tn, b6, Tn)
+            B_gyro = B_gyro + on * (Tn.T @ Bg @ Tn)
+            A00 = A00.at[:, ir].set(on * a6[0, 0, :])
+            B00 = B00.at[:, ir].set(on * b6[0, 0, :])
+            Om_s, pit_s = operating_point(rot, speed_safe)
+            Om_out = Om_out.at[ir].set(on * Om_s)
+            pitch_out = pitch_out.at[ir].set(on * pit_s)
+
+        # ---- current loads at the reference pose
+        F_current = morison.current_loads(
+            fs, ss, hc0, cur_speed, cur_heading,
+            min([r.Zhub for r in fs.rotors if r.Zhub < 0], default=0.0),
+            Tn0, jnp.asarray(fs.node_r0))
+
+        # ---- mean-offset equilibrium under environmental mean loads
+        from raft_tpu.models.statics_solve import solve_equilibrium_general, single_ms_closures
+        force, stiff = single_ms_closures(ms, nDOF)
+        F_env = F_current + f_aero0
+        X0, _ = solve_equilibrium_general(
+            jnp.asarray(K_h), jnp.asarray(F_und), F_env, force, stiff,
+            tol_vec, caps, refs, C_elast=jnp.asarray(C_elast))
+
+        # ---- pose-dependent strip frames
+        r_nodes, R_ptfm, r_root = platform_kinematics(fs, X0)
+        Tn = node_T(r_nodes, r_root)
+        r, q, p1, p2 = morison.strip_frames(ss, R_ptfm, r_nodes)
+        sub = r[:, 2] < 0
+        hc = dict(hc0, r=r, q=q, p1=p1, p2=p2, sub=sub,
+                  active=sub & jnp.asarray(ss.active))
+
+        # ---- sea states + first-order excitation (all headings)
+        S = jax.vmap(lambda h, t, g_: wv.jonswap(w, h, t, gamma=g_))(Hs, Tp, gamma)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        exc = morison.hydro_excitation(fs, ss, hc, zeta, beta, w, k, Tn, r_nodes)
+
+        F_BEM = jnp.zeros((nWaves, nDOF, nw), dtype=complex)
+        if has_X:
+            def bem_one(bd):
+                phase = jnp.exp(-1j * k * (
+                    fs.x_ref * jnp.cos(jnp.deg2rad(bd))
+                    + fs.y_ref * jnp.sin(jnp.deg2rad(bd))))
+                X = _interp_heading_traced(
+                    bem["X_BEM"], bem["headings"], (bd - fs.heading_adjust) % 360)
+                return X * phase
+            F_BEM = F_BEM.at[:, :6, :].set(
+                jax.vmap(bem_one)(beta_deg) * zeta[:, None, :])
+
+        # ---- second-order forces (external QTF)
+        F_2nd = jnp.zeros((nWaves, nDOF, nw), dtype=complex)
+        F_2nd_mean = jnp.zeros((nWaves, nDOF))
+        if Qm is not None:
+            def qtf_one(b_h, S_h):
+                return _hydro_force_2nd_traced(Qm, qtf["heads_rad"], b_h, S_h, dw)
+            fm, f2 = jax.vmap(qtf_one)(beta, S)
+            F_2nd = F_2nd.at[:, :6, :].set(f2.astype(complex))
+            F_2nd_mean = F_2nd_mean.at[:, :6].set(fm)
+
+        # ---- linear system (raft_model.py:1045-1048)
+        C_moor = jnp.zeros((nDOF, nDOF))
+        if ms is not None:
+            C_moor = C_moor.at[:6, :6].add(mooring_stiffness(ms, X0[:6]))
+        M_lin = A_aero + (M_struc + A_hydro)[:, :, None] + jnp.asarray(A_BEM)
+        B_lin = B_aero + jnp.asarray(B_BEM) + B_gyro[:, :, None]
+        C_lin = jnp.asarray(K_h) + C_moor + jnp.asarray(C_elast)
+        F_lin = F_BEM[0] + exc["F_hydro_iner"][0] + F_2nd[0]
+
+        Z, _, Bmat = solve_dynamics_fowt(
+            fs, ss, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
+            w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart)
+
+        # ---- per-heading responses + zero rotor-source row
+        # (reference leaves the rotor excitation row zero,
+        # raft_model.py:1246-1255)
+        def fwave_one(ih):
+            F_drag = morison.drag_excitation(fs, ss, hc, Bmat, exc["u"][ih],
+                                             Tn, r_nodes)
+            return F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag + F_2nd[ih]
+        F_waves = jnp.stack([fwave_one(ih) for ih in range(nWaves)])
+        Xi = system_response(Z, F_waves)
+        Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=complex)])
+
+        # ---- mean-drift fed back into the equilibrium for the reported
+        # offsets (raft_model.py:316-328); Xi is not recomputed
+        X0_out = X0
+        if Qm is not None:
+            X0_out, _ = solve_equilibrium_general(
+                jnp.asarray(K_h), jnp.asarray(F_und),
+                F_env + jnp.sum(F_2nd_mean, axis=0), force, stiff,
+                tol_vec, caps, refs, C_elast=jnp.asarray(C_elast))
+
+        RAO = wv.get_rao(Xi[0], zeta[0])
+        PSD = jnp.sum(0.5 * jnp.abs(Xi) ** 2 / dw, axis=0)
+        return dict(
+            X0=X0_out, Xi=Xi, RAO=RAO, PSD=PSD, S=S, zeta=zeta,
+            f_aero=f_aero, A00=A00, B00=B00, f_aero0=f_aero0,
+            Omega_rpm=Om_out, pitch_deg=pitch_out,
+            F_2nd_mean=F_2nd_mean, Z=Z,
+        )
+
+    return evaluate
+
+
 def make_case_evaluator(model, n_stat_iter=12):
     """Build ``evaluate(Hs, Tp, beta) -> outputs`` for one design.
 

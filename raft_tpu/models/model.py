@@ -240,6 +240,22 @@ class Model:
         self.X0 = X
         return X
 
+    def _resolve_data_path(self, path, suffixes=("",)):
+        """Resolve a design-file-relative data path.  The reference
+        resolves such paths against the CWD it is launched from (repo
+        root for the shipped examples); here we try the design file's
+        directory and its parent so the shipped YAMLs work in place."""
+        import os
+
+        if os.path.isabs(path) or self.base_dir is None:
+            return path
+        for base in (self.base_dir,
+                     os.path.normpath(os.path.join(self.base_dir, ".."))):
+            cand = os.path.normpath(os.path.join(base, path))
+            if any(os.path.exists(cand + s) for s in suffixes):
+                return cand
+        return os.path.join(self.base_dir, path)
+
     @property
     def qtf(self):
         """Lazy difference-frequency QTF data (potSecOrder == 2 path)."""
@@ -251,9 +267,7 @@ class Model:
 
                 from raft_tpu.physics.secondorder import read_qtf_12d
 
-                path = fs.hydroPath + ".12d"
-                if self.base_dir is not None and not os.path.isabs(path):
-                    path = os.path.join(self.base_dir, path)
+                path = self._resolve_data_path(fs.hydroPath, (".12d",)) + ".12d"
                 if os.path.exists(path):
                     self._qtf = read_qtf_12d(path, rho=fs.rho_water, g=fs.g)
         return self._qtf
@@ -327,9 +341,8 @@ class Model:
             Tn = np.asarray(fh.Tn[node])  # (6, nDOF)
             out["f_aero0"][:, ir] = Tn.T @ f0
             out["f_aero"] += Tn.T @ f
-            for iw in range(nw):
-                out["A_aero"][:, :, iw] += Tn.T @ a[:, :, iw] @ Tn
-                out["B_aero"][:, :, iw] += Tn.T @ b[:, :, iw] @ Tn
+            out["A_aero"] += np.einsum("ia,ijw,jb->abw", Tn, a, Tn)
+            out["B_aero"] += np.einsum("ia,ijw,jb->abw", Tn, b, Tn)
             out["A00"][:, ir] = a[0, 0, :]
             out["B00"][:, ir] = b[0, 0, :]
             # gyroscopic damping (raft_fowt.py:1569-1581)
@@ -480,13 +493,9 @@ class Model:
             self._bem = None
             fs = self.fowtList[0]
             if fs.potFirstOrder == 1 and fs.hydroPath:
-                import os
-
                 from raft_tpu.io.wamit import load_bem_coefficients
 
-                path = fs.hydroPath
-                if self.base_dir is not None and not os.path.isabs(path):
-                    path = os.path.join(self.base_dir, path)
+                path = self._resolve_data_path(fs.hydroPath, (".1", ".3"))
                 self._bem = load_bem_coefficients(
                     path, self.w, fs.rho_water, fs.g,
                     r_ref=fs.node_r0[fs.root_id],

@@ -562,6 +562,186 @@ def calc_aero(rot: RotorAeroModel, rprops, case, w, speed=None,
                              pitch_deg=float(pit), V_w=V_w, R_q=R_q, q=q)
 
 
+# ------------------------------------------------- traced aero-servo path
+
+_KAIMAL_TABLE = None
+
+
+def _kaimal_G_table():
+    """Build-time f64 tabulation of the special-function combination in
+    the rotor-averaged Kaimal spectrum (raft_rotor.py:1243-1246):
+
+        G(x) = L_1(x) - I_1(x) - 2/pi + (x/2) (-2 L_{-2}(x) + 2 I_2(x) + 1)
+
+    The reference evaluates this directly with scipy (incl. its float64
+    cancellation noise for x in ~[30, 100]); the traced path interpolates
+    this dense log-spaced table instead, so the one scipy-only special
+    function pair on the aero path becomes a constant tensor
+    (SURVEY.md §7.3 hard-part 5)."""
+    global _KAIMAL_TABLE
+    if _KAIMAL_TABLE is None:
+        from scipy.special import iv, modstruve
+
+        x = np.logspace(-8, 5, 8192)
+        with np.errstate(all="ignore"):
+            G = (
+                modstruve(1, x) - iv(1, x) - 2 / np.pi
+                + (x / 2) * (-2 * modstruve(-2, x) + 2 * iv(2, x) + 1)
+            )
+        _KAIMAL_TABLE = (np.log(x), np.nan_to_num(G))
+    return _KAIMAL_TABLE
+
+
+def parse_turbulence(turbulence):
+    """Static part of a case's turbulence spec.
+
+    Returns (I_ref, V_ref_cls, TurbMod); I_ref is None when the spec is
+    a numeric TI (which may then be a traced value)."""
+    V_ref_cls = 50.0
+    I_ref = None
+    TurbMod = "NTM"
+    if isinstance(turbulence, str):
+        cls = ""
+        ch = ""
+        for ch in turbulence:
+            if ch in ("I", "V"):
+                cls += ch
+            else:
+                break
+        if cls:
+            I_ref = {"A+": 0.18, "A": 0.16, "B": 0.14, "C": 0.12}[ch]
+            V_ref_cls = {"I": 50.0, "II": 42.5, "III": 37.5, "IV": 30.0}[cls]
+            TurbMod = turbulence.split("_")[1]
+        else:
+            I_ref = None  # numeric string: TI value
+    return I_ref, V_ref_cls, TurbMod
+
+
+def kaimal_rot_psd_traced(w, V_ref, I_ref, hub_height, R_rot,
+                          TurbMod="NTM", V_ref_cls=50.0):
+    """Traced twin of :func:`kaimal_rot_psd`: V_ref and I_ref may be
+    traced scalars; the special-function combination comes from the
+    build-time table."""
+    w = jnp.asarray(w)
+    f = w / (2 * jnp.pi)
+    HH = abs(float(hub_height))
+
+    if TurbMod == "NTM":
+        sigma_1 = I_ref * (0.75 * V_ref + 5.6)
+    elif TurbMod == "ETM":
+        V_ave = V_ref_cls * 0.2
+        sigma_1 = 2 * I_ref * (0.072 * (V_ave / 2 + 3) * (V_ref / 2 - 4) + 10)
+    elif TurbMod == "EWM":
+        sigma_1 = 0.11 * V_ref
+    else:
+        raise ValueError(f"unsupported turbulence model {TurbMod}")
+
+    L_1 = 0.7 * HH if HH <= 60 else 42.0
+    L_u = 8.1 * L_1
+    U = (4 * L_u / V_ref) * sigma_1**2 / ((1 + 6 * f * L_u / V_ref) ** (5.0 / 3.0))
+    kappa = 12 * jnp.sqrt((f / V_ref) ** 2 + (0.12 / L_u) ** 2)
+    t = R_rot * kappa
+    logx, G = _kaimal_G_table()
+    Gx = jnp.interp(jnp.log(jnp.maximum(2 * t, 1e-300)),
+                    jnp.asarray(logx), jnp.asarray(G))
+    t_safe = jnp.where(t == 0, 1.0, t)
+    return jnp.where(t == 0, 0.0, 2 * U / t_safe**3 * Gx)
+
+
+def calc_aero_traced(rot: RotorAeroModel, rprops, w, speed, heading_rad,
+                     TI, yaw_command_rad=0.0, turbine_heading_rad=0.0,
+                     turb_static=("NTM", 50.0)):
+    """Fully traced aero-servo coefficients about the rotor node.
+
+    jax twin of :func:`calc_aero` (Rotor.calcAero equivalent,
+    raft_rotor.py:806-1028) with ``speed``, ``heading_rad``, ``TI`` and
+    ``yaw_command_rad`` as traced scalars, so the whole aero path jits
+    and vmaps over load cases.  Returns
+    (f0 (6,), f (6,nw) complex, a (6,6,nw), b (6,6,nw), B_gyro (6,6), q).
+    """
+    from raft_tpu.ops import transforms as tf
+
+    w = jnp.asarray(w)
+    nw = w.shape[0]
+    dw = w[1] - w[0]
+    yaw_mode = getattr(rprops, "yaw_mode", 0)
+
+    # setYaw (raft_rotor.py:425-478); platform heading handled upstream
+    if yaw_mode == 0:
+        yaw = heading_rad + yaw_command_rad
+    elif yaw_mode == 1:
+        yaw = turbine_heading_rad
+    elif yaw_mode == 2:
+        yaw = yaw_command_rad
+    elif yaw_mode == 3:
+        yaw = yaw_command_rad
+    else:
+        raise ValueError("unsupported yaw_mode")
+
+    R_q = tf.rotation_matrix(0.0, -rprops.shaft_tilt, rprops.shaft_toe + yaw)
+    q = R_q @ jnp.array([1.0, 0.0, 0.0])
+    yaw_misalign = jnp.arctan2(q[1], q[0]) - heading_rad
+    turbine_tilt = jnp.arctan2(q[2], jnp.hypot(q[0], q[1]))
+
+    Om, pit = operating_point(rot, speed)
+    loads, dT, dQ = rotor_loads_and_derivs(rot, speed, Om, pit,
+                                           -turbine_tilt, yaw_misalign)
+    dT_dU, dT_dOm, dT_dPi = dT[0], dT[1] / RPM2RADPS, dT[2] * RAD2DEG
+    dQ_dU, dQ_dOm, dQ_dPi = dQ[0], dQ[1] / RPM2RADPS, dQ[2] * RAD2DEG
+
+    f0 = jnp.concatenate([R_q @ loads[:3], R_q @ loads[3:]])
+
+    TurbMod, V_ref_cls = turb_static
+    S_rot = kaimal_rot_psd_traced(w, speed, TI, rprops.Zhub, rot.Rtip,
+                                  TurbMod=TurbMod, V_ref_cls=V_ref_cls)
+    V_w = jnp.sqrt(2 * S_rot * dw).astype(complex)
+
+    # hub-frame coefficients reduce to the thrust-axis outer product
+    qq = jnp.outer(q, q)  # (3,3)
+    if rprops.aeroServoMod == 1:
+        a2 = jnp.zeros(nw)
+        b2 = jnp.full(nw, dT_dU)
+        f2 = dT_dU * V_w
+    elif rprops.aeroServoMod == 2:
+        kp_beta = -jnp.interp(speed, jnp.asarray(rot.U_sched), jnp.asarray(rot.kp_0))
+        ki_beta = -jnp.interp(speed, jnp.asarray(rot.U_sched), jnp.asarray(rot.ki_0))
+        kp_tau = rot.kp_tau * (kp_beta == 0)
+        ki_tau = rot.ki_tau * (ki_beta == 0)
+        zhub = rprops.Zhub
+        H_QT = ((dT_dOm + kp_beta * dT_dPi) * 1j * w + ki_beta * dT_dPi) / (
+            rot.I_drivetrain * w**2
+            + (dQ_dOm + kp_beta * dQ_dPi - rot.Ng * kp_tau) * 1j * w
+            + ki_beta * dQ_dPi - rot.Ng * ki_tau
+        )
+        f2 = (dT_dU - H_QT * dQ_dU) * V_w
+        resp = (dT_dU - rot.k_float * dT_dPi / zhub
+                - H_QT * (dQ_dU - rot.k_float * dQ_dPi / zhub))
+        b2 = jnp.real(resp)
+        a2 = jnp.real(resp / (1j * w))
+    else:
+        a2 = jnp.zeros(nw)
+        b2 = jnp.zeros(nw)
+        f2 = jnp.zeros(nw, dtype=complex)
+
+    a6 = jnp.zeros((nw, 6, 6)).at[:, :3, :3].set(a2[:, None, None] * qq)
+    b6 = jnp.zeros((nw, 6, 6)).at[:, :3, :3].set(b2[:, None, None] * qq)
+    f6 = jnp.zeros((nw, 6), dtype=complex).at[:, :3].set(f2[:, None] * q)
+
+    # shift from hub to the rotor node (raft_rotor.py:1021-1026)
+    r_off = q * rprops.overhang
+    f0 = tf.transform_force_6(f0, r_off)
+    a6 = tf.translate_matrix_6to6(a6, r_off)          # batched over ω
+    b6 = tf.translate_matrix_6to6(b6, r_off)
+    f6 = tf.transform_force_6(f6, r_off)
+
+    # gyroscopic damping (raft_fowt.py:1569-1581)
+    IO = q * (rprops.I_drivetrain * Om * 2 * jnp.pi / 60)
+    B_gyro = jnp.zeros((6, 6)).at[3:, 3:].set(tf.skew(IO))
+
+    return (f0, jnp.moveaxis(f6, 0, -1), jnp.moveaxis(a6, 0, -1),
+            jnp.moveaxis(b6, 0, -1), B_gyro, q)
+
+
 # -------------------------------------------------------- Kaimal spectrum
 
 def kaimal_rot_psd(w, V_ref, turbulence, hub_height, R_rot):

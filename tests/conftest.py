@@ -23,6 +23,14 @@ jax.config.update("jax_enable_x64", True)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running parity/integration tests (excluded from the "
+        "fast tier: pytest -m 'not slow')",
+    )
+
 REFERENCE_DIR = "/root/reference"
 REF_TEST_DATA = os.path.join(REFERENCE_DIR, "tests", "test_data")
 

@@ -40,6 +40,7 @@ def rotor_and_golden():
     return rot, true
 
 
+@pytest.mark.slow
 def test_hub_loads_vs_ccblade(rotor_and_golden):
     rot, true = rotor_and_golden
     tilt = -6 * np.pi / 180

@@ -19,6 +19,8 @@ from tests.conftest import ref_data
 
 import raft_tpu
 
+pytestmark = pytest.mark.slow
+
 METRICS = [
     "wave_PSD", "surge_PSD", "sway_PSD", "heave_PSD", "roll_PSD",
     "pitch_PSD", "yaw_PSD", "AxRNA_PSD", "Mbase_PSD", "Tmoor_PSD",

@@ -13,6 +13,8 @@ from tests.conftest import REFERENCE_DIR
 
 import raft_tpu
 
+pytestmark = pytest.mark.slow
+
 DESIGNS = sorted(
     glob.glob(os.path.join(REFERENCE_DIR, "designs", "*.yaml"))
     + glob.glob(os.path.join(REFERENCE_DIR, "examples", "*.yaml"))

@@ -39,6 +39,7 @@ def test_design_vmap(evaluator):
     assert peak[0] > peak[-1]
 
 
+@pytest.mark.slow
 def test_design_gradient(evaluator):
     """Exact gradient of a response metric wrt a design parameter."""
 

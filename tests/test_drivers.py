@@ -8,6 +8,8 @@ import pytest
 from tests.conftest import ref_data
 
 
+pytestmark = pytest.mark.slow
+
 def test_run_and_csv(tmp_path):
     from raft_tpu.drivers import run
 

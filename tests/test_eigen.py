@@ -15,6 +15,8 @@ from tests.conftest import ref_data
 
 import raft_tpu
 
+pytestmark = pytest.mark.slow
+
 UNLOADED_CASE = {
     "wind_speed": 0, "wind_heading": 0, "turbulence": 0,
     "turbine_status": "idle", "yaw_misalign": 0,

@@ -19,6 +19,8 @@ from tests.conftest import ref_data
 
 import raft_tpu
 
+pytestmark = pytest.mark.slow
+
 WAVE_CASE = {
     "wind_speed": 0, "wind_heading": 0, "turbulence": 0,
     "turbine_status": "operating", "yaw_misalign": 0,

@@ -21,6 +21,8 @@ from tests.conftest import ref_data
 
 import raft_tpu
 
+pytestmark = pytest.mark.slow
+
 PATH = ref_data("VolturnUS-S-flexible.yaml")
 
 WAVE_CASE = {

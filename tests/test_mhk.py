@@ -11,6 +11,8 @@ from tests.conftest import REFERENCE_DIR
 
 import raft_tpu
 
+pytestmark = pytest.mark.slow
+
 PATH = os.path.join(REFERENCE_DIR, "designs", "RM1_Floating.yaml")
 
 

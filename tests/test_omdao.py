@@ -1,9 +1,13 @@
 """Optimizer-facing design-evaluation API tests (openmdao-free path)."""
 
+import pytest
+
 import os
 
 import numpy as np
 
+
+pytestmark = pytest.mark.slow
 
 def test_design_evaluation_compute():
     from raft_tpu.omdao import DesignEvaluation

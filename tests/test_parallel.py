@@ -17,6 +17,8 @@ import raft_tpu
 from raft_tpu.api import make_case_evaluator
 from raft_tpu.parallel.sweep import make_mesh, run_sweep_checkpointed, sweep_cases
 
+pytestmark = pytest.mark.slow
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 SPAR = os.path.join(HERE, "..", "raft_tpu", "designs", "spar_demo.yaml")
 

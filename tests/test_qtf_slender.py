@@ -17,6 +17,8 @@ from tests.conftest import ref_data
 import raft_tpu
 from raft_tpu.physics.qtf_slender import fowt_qtf_slender
 
+pytestmark = pytest.mark.slow
+
 DESIGNS = ["VolturnUS-S.yaml", "VolturnUS-S-pointInertia.yaml"]
 
 

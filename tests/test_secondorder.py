@@ -44,6 +44,7 @@ def test_mean_drift_downwave(qtf):
     assert f[0, :-1].max() > 0
 
 
+@pytest.mark.slow
 def test_oc4_model_runs_with_qtf():
     path = ref_data("OC4semi-WAMIT_Coefs.yaml")
     if not os.path.exists(path):

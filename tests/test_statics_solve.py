@@ -18,6 +18,8 @@ from tests.conftest import ref_data
 
 import raft_tpu
 
+pytestmark = pytest.mark.slow
+
 CASES = {
     "wave": {
         "wind_speed": 0, "wind_heading": 0, "turbulence": 0,
