@@ -126,6 +126,314 @@ int lu_solve(std::vector<double>& A, std::vector<double>& b, int n) {
 
 }  // namespace
 
+// ---------------------------------------------------------------------------
+// finite-frequency machinery
+// ---------------------------------------------------------------------------
+
+#include <complex>
+
+namespace {
+
+using cd = std::complex<double>;
+
+// complex dense partial-pivot LU with multiple right-hand sides.
+// A (n x n row major) is destroyed; B is (nrhs x n) row-per-RHS.
+int lu_solve_cplx(std::vector<cd>& A, std::vector<cd>& B, int n, int nrhs) {
+  for (int k = 0; k < n; ++k) {
+    int pk = k;
+    double amax = std::abs(A[static_cast<size_t>(k) * n + k]);
+    for (int i = k + 1; i < n; ++i) {
+      double a = std::abs(A[static_cast<size_t>(i) * n + k]);
+      if (a > amax) {
+        amax = a;
+        pk = i;
+      }
+    }
+    if (amax < 1e-30) return 1;
+    if (pk != k) {
+      for (int j = 0; j < n; ++j)
+        std::swap(A[static_cast<size_t>(k) * n + j], A[static_cast<size_t>(pk) * n + j]);
+      for (int r = 0; r < nrhs; ++r)
+        std::swap(B[static_cast<size_t>(r) * n + k], B[static_cast<size_t>(r) * n + pk]);
+    }
+    cd inv = 1.0 / A[static_cast<size_t>(k) * n + k];
+    for (int i = k + 1; i < n; ++i) {
+      cd f = A[static_cast<size_t>(i) * n + k] * inv;
+      if (f == cd(0.0, 0.0)) continue;
+      A[static_cast<size_t>(i) * n + k] = f;
+      for (int j = k + 1; j < n; ++j)
+        A[static_cast<size_t>(i) * n + j] -= f * A[static_cast<size_t>(k) * n + j];
+      for (int r = 0; r < nrhs; ++r)
+        B[static_cast<size_t>(r) * n + i] -= f * B[static_cast<size_t>(r) * n + k];
+    }
+  }
+  for (int r = 0; r < nrhs; ++r) {
+    for (int i = n - 1; i >= 0; --i) {
+      cd s = B[static_cast<size_t>(r) * n + i];
+      for (int j = i + 1; j < n; ++j)
+        s -= A[static_cast<size_t>(i) * n + j] * B[static_cast<size_t>(r) * n + j];
+      B[static_cast<size_t>(r) * n + i] = s / A[static_cast<size_t>(i) * n + i];
+    }
+  }
+  return 0;
+}
+
+// bilinear lookup in the (ln d, alpha = R/d) wave-term tables
+struct GreenTab {
+  int nd, na;
+  const double *lnd, *alpha, *L, *M;
+};
+
+inline double tab_interp(const GreenTab& t, const double* T, double x, double a) {
+  if (x < t.lnd[0]) x = t.lnd[0];
+  if (x > t.lnd[t.nd - 1]) x = t.lnd[t.nd - 1];
+  if (a < 0) a = 0;
+  if (a > 1) a = 1;
+  // uniform grids
+  double fx = (x - t.lnd[0]) / (t.lnd[t.nd - 1] - t.lnd[0]) * (t.nd - 1);
+  double fa = (a - t.alpha[0]) / (t.alpha[t.na - 1] - t.alpha[0]) * (t.na - 1);
+  int i = static_cast<int>(fx);
+  int j = static_cast<int>(fa);
+  if (i > t.nd - 2) i = t.nd - 2;
+  if (j > t.na - 2) j = t.na - 2;
+  fx -= i;
+  fa -= j;
+  const double* row0 = T + static_cast<size_t>(i) * t.na;
+  const double* row1 = row0 + t.na;
+  return (1 - fx) * ((1 - fa) * row0[j] + fa * row0[j + 1]) +
+         fx * ((1 - fa) * row1[j] + fa * row1[j + 1]);
+}
+
+// wave part of the Green function (kernel normalisation 1/(4 pi r)):
+// potential and gradient at field p due to a unit source at q, both z<0.
+// K: wavenumber.  Uses G_w = (1/4pi)[2K L + i 2 pi K e^Z J0].
+struct WaveEval {
+  cd pot;
+  cd grad[3];  // d/dx, d/dy, d/dz at the field point
+};
+
+inline WaveEval wave_term(const GreenTab& t, double K, const V3& p, const V3& q) {
+  double dx = p.x - q.x, dy = p.y - q.y;
+  double Rh = std::sqrt(dx * dx + dy * dy);
+  double R = K * Rh;
+  double Z = K * (p.z + q.z);
+  if (Z > -1e-12) Z = -1e-12;
+  double d = std::sqrt(R * R + Z * Z);
+  double x = std::log(d > 1e-300 ? d : 1e-300);
+  double a = (d > 0 ? R / d : 0.0);
+  double L = tab_interp(t, t.L, x, a);
+  double M = tab_interp(t, t.M, x, a);
+
+  double eZ = std::exp(Z);
+  double J0 = j0(R);
+  double J1 = j1(R);
+
+  const double c = 1.0 / (4.0 * M_PI);
+  WaveEval w;
+  w.pot = c * cd(2.0 * K * L, 2.0 * M_PI * K * eZ * J0);
+
+  // dL/dR = -((d - |Z|)/(R d) + M); dL/dZ = L + 1/d
+  double dLdR = (R > 1e-12) ? -((d + Z) / (R * d) + M) : 0.0;  // Z<0: |Z|=-Z
+  double dLdZ = L + 1.0 / d;
+  double dRe_dRh = c * 2.0 * K * K * dLdR;
+  double dIm_dRh = -c * 2.0 * M_PI * K * K * eZ * J1;
+  double dRe_dz = c * 2.0 * K * K * dLdZ;
+  double dIm_dz = c * 2.0 * M_PI * K * K * eZ * J0;
+
+  double ux = (Rh > 1e-12) ? dx / Rh : 0.0;
+  double uy = (Rh > 1e-12) ? dy / Rh : 0.0;
+  w.grad[0] = cd(dRe_dRh * ux, dIm_dRh * ux);
+  w.grad[1] = cd(dRe_dRh * uy, dIm_dRh * uy);
+  w.grad[2] = cd(dRe_dz, dIm_dz);
+  return w;
+}
+
+// wave term integrated over source panel j by its 2x2 Gauss points
+inline WaveEval wave_panel(const GreenTab& t, double K, const V3& p,
+                           const V3* verts, double area) {
+  static const double gp[2] = {-0.5773502691896257, 0.5773502691896257};
+  WaveEval acc;
+  acc.pot = 0;
+  acc.grad[0] = acc.grad[1] = acc.grad[2] = 0;
+  for (int iu = 0; iu < 2; ++iu) {
+    for (int iv = 0; iv < 2; ++iv) {
+      double u = 0.5 * (1 + gp[iu]);
+      double v = 0.5 * (1 + gp[iv]);
+      V3 q{
+          (1 - u) * (1 - v) * verts[0].x + u * (1 - v) * verts[1].x +
+              u * v * verts[2].x + (1 - u) * v * verts[3].x,
+          (1 - u) * (1 - v) * verts[0].y + u * (1 - v) * verts[1].y +
+              u * v * verts[2].y + (1 - u) * v * verts[3].y,
+          (1 - u) * (1 - v) * verts[0].z + u * (1 - v) * verts[1].z +
+              u * v * verts[2].z + (1 - u) * v * verts[3].z,
+      };
+      WaveEval w = wave_term(t, K, p, q);
+      acc.pot += 0.25 * area * w.pot;
+      for (int k = 0; k < 3; ++k) acc.grad[k] += 0.25 * area * w.grad[k];
+    }
+  }
+  return acc;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Solve radiation (6 modes) + diffraction (nh headings) at ONE frequency.
+//
+// Geometry as in panel_radiation_added_mass.  K is the (finite-depth
+// mapped) wavenumber, omega the angular frequency, rho/g fluid
+// properties, ref the reference point for rotational modes.
+// Wave tables are passed from Python (see raft_tpu/native/green_table.py).
+//
+// Outputs: A_out/B_out (6x6) added mass / radiation damping;
+// X_out (nh x 6 x 2): excitation force complex amplitudes per unit wave
+// amplitude (WAMIT heading convention: beta measured from +x).
+int panel_solve_frequency(int n, const double* vertices, const double* centroid,
+                          const double* normal, const double* area, double K,
+                          double omega, double rho, double g, const double* ref,
+                          int nh, const double* headings, int nd, int na,
+                          const double* lnd_grid, const double* alpha_grid,
+                          const double* Ltab, const double* Mtab, double* A_out,
+                          double* B_out, double* X_out) {
+  const V3* verts = reinterpret_cast<const V3*>(vertices);
+  const V3* cen = reinterpret_cast<const V3*>(centroid);
+  const V3* nor = reinterpret_cast<const V3*>(normal);
+  const V3 r0{ref[0], ref[1], ref[2]};
+  GreenTab tab{nd, na, lnd_grid, alpha_grid, Ltab, Mtab};
+
+  // ---- influence matrices: normal velocity G_v and potential P at
+  // centroid i from unit source on panel j (Rankine + positive image +
+  // wave term)
+  std::vector<cd> Gv(static_cast<size_t>(n) * n);
+  std::vector<cd> P(static_cast<size_t>(n) * n);
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j < n; ++j) {
+      double g_re, p_re;
+      if (i == j) {
+        g_re = 0.5;
+        double a_eq = std::sqrt(area[j] / M_PI);
+        p_re = 0.5 * a_eq;
+      } else {
+        V3 vel = quad_velocity(&verts[4 * j], area[j], cen[i]);
+        g_re = dot(vel, nor[i]);
+        p_re = quad_potential(&verts[4 * j], area[j], cen[i]);
+      }
+      // positive image above z = 0 (the 1/r1 term of the wave G)
+      V3 iv[4];
+      for (int k = 0; k < 4; ++k) {
+        iv[k] = verts[4 * j + k];
+        iv[k].z = -iv[k].z;
+      }
+      V3 velm = quad_velocity(iv, area[j], cen[i]);
+      double phim = quad_potential(iv, area[j], cen[i]);
+      g_re += dot(velm, nor[i]);
+      p_re += phim;
+      // wave term (smooth; 2x2 Gauss over the source panel).
+      //
+      // Sign convention: the Rankine blocks above follow the legacy
+      // rows (g_re = -(true gradient) . n, +0.5 diagonal), i.e. the
+      // assembled system solves  -dphi/dn = rhs.  The wave gradient is
+      // the TRUE field-point gradient, so it enters with a minus; the
+      // potential matrix is negated wholesale so that phi = P sigma
+      // recovers the true potential for the sign-flipped sigma.
+      WaveEval w = wave_panel(tab, K, cen[i], &verts[4 * j], area[j]);
+      cd gn = w.grad[0] * nor[i].x + w.grad[1] * nor[i].y + w.grad[2] * nor[i].z;
+      Gv[static_cast<size_t>(i) * n + j] = cd(g_re, 0.0) - gn;
+      P[static_cast<size_t>(i) * n + j] = -(cd(p_re, 0.0) + w.pot);
+    }
+  }
+
+  // ---- right-hand sides: 6 radiation modes + nh diffraction headings
+  int nrhs = 6 + nh;
+  std::vector<cd> rhs(static_cast<size_t>(nrhs) * n);
+  std::vector<double> nmode(static_cast<size_t>(6) * n);
+  for (int i = 0; i < n; ++i) {
+    V3 rr = sub(cen[i], r0);
+    double nm[6] = {nor[i].x,
+                    nor[i].y,
+                    nor[i].z,
+                    rr.y * nor[i].z - rr.z * nor[i].y,
+                    rr.z * nor[i].x - rr.x * nor[i].z,
+                    rr.x * nor[i].y - rr.y * nor[i].x};
+    for (int m = 0; m < 6; ++m) {
+      nmode[static_cast<size_t>(m) * n + i] = nm[m];
+      rhs[static_cast<size_t>(m) * n + i] = nm[m];
+    }
+  }
+  // incident potential for UNIT POSITIVE elevation amplitude travelling
+  // toward heading beta (e^{-i omega t} convention; elevation
+  // zeta = (i omega / g) phi_I at z=0):
+  //   phi_I = -(i g / omega) e^{Kz} e^{+i K (x cosb + y sinb)}
+  // diffraction BC: d(phi_S)/dn = -d(phi_I)/dn
+  std::vector<cd> phiI(static_cast<size_t>(nh) * n);
+  for (int h = 0; h < nh; ++h) {
+    double cb = std::cos(headings[h]);
+    double sb = std::sin(headings[h]);
+    for (int i = 0; i < n; ++i) {
+      cd e = std::exp(cd(K * cen[i].z, K * (cen[i].x * cb + cen[i].y * sb)));
+      cd pI = cd(0.0, -g / omega) * e;
+      phiI[static_cast<size_t>(h) * n + i] = pI;
+      cd dpx = pI * cd(0.0, K * cb);
+      cd dpy = pI * cd(0.0, K * sb);
+      cd dpz = pI * K;
+      // the assembled system solves -dphi/dn = rhs and phi is read back
+      // through the negated potential matrix, so the scattering BC
+      // dphi_S/dn = -dphi_I/dn enters with rhs = -dphi_I/dn (the double
+      // sign flip cancels; radiation absorbs it in the A/B formulas)
+      rhs[static_cast<size_t>(6 + h) * n + i] =
+          -(dpx * nor[i].x + dpy * nor[i].y + dpz * nor[i].z);
+    }
+  }
+
+  std::vector<cd> Gc(Gv);
+  if (lu_solve_cplx(Gc, rhs, n, nrhs)) return 1;
+
+  // ---- potentials on the body per RHS
+  std::vector<cd> phi(static_cast<size_t>(nrhs) * n);
+  for (int r = 0; r < nrhs; ++r) {
+    for (int i = 0; i < n; ++i) {
+      cd s = 0;
+      for (int j = 0; j < n; ++j)
+        s += P[static_cast<size_t>(i) * n + j] * rhs[static_cast<size_t>(r) * n + j];
+      phi[static_cast<size_t>(r) * n + i] = s;
+    }
+  }
+
+  // ---- radiation: with true potentials (e^{-i omega t} convention)
+  // rho int phi_m n_k dS = -A_km - (i/omega) B_km
+  for (int k = 0; k < 6; ++k) {
+    for (int m = 0; m < 6; ++m) {
+      cd s = 0;
+      for (int i = 0; i < n; ++i)
+        s += phi[static_cast<size_t>(m) * n + i] *
+             nmode[static_cast<size_t>(k) * n + i] * area[i];
+      A_out[k * 6 + m] = -rho * s.real();
+      B_out[k * 6 + m] = -rho * omega * s.imag();
+    }
+  }
+
+  // ---- excitation: X_k = -i omega rho int (phi_I + phi_S) n_k dS
+  for (int h = 0; h < nh; ++h) {
+    for (int k = 0; k < 6; ++k) {
+      cd s = 0;
+      for (int i = 0; i < n; ++i)
+        s += (phiI[static_cast<size_t>(h) * n + i] +
+              phi[static_cast<size_t>(6 + h) * n + i]) *
+             nmode[static_cast<size_t>(k) * n + i] * area[i];
+      cd X = cd(0.0, -omega) * rho * s;
+      // conjugate: the WAMIT-format files the reference pipeline
+      // consumes (and the HAMS outputs validated against) carry the
+      // e^{+i omega t} phase convention
+      X_out[(h * 6 + k) * 2] = X.real();
+      X_out[(h * 6 + k) * 2 + 1] = -X.imag();
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
+
 extern "C" {
 
 // Solve the radiation problem for all 6 rigid-body modes.

@@ -119,6 +119,255 @@ def mesh_cylinder(stations, diameters, rA, q, n_az=18, dz_max=2.0):
             np.asarray(areas))
 
 
+def mesh_cylinder_capped(stations, diameters, rA, q, n_az=18, dz_max=2.0,
+                         p1=None, p2=None):
+    """:func:`mesh_cylinder` plus a top cap when the upper end is
+    submerged (fully submerged members — pontoons, heave plates — need
+    closed surfaces for the panel solver)."""
+    verts, cents, norms, areas = mesh_cylinder(
+        stations, diameters, rA, q, n_az=n_az, dz_max=dz_max)
+    rA = np.asarray(rA, dtype=float)
+    q = np.asarray(q, dtype=float) / np.linalg.norm(q)
+    zTop = rA[2] + q[2] * stations[-1]
+    if zTop < -1e-9 and len(verts):
+        tmp = np.array([1.0, 0, 0]) if abs(q[2]) > 0.9 else np.array([0, 0, 1.0])
+        p1v = np.cross(tmp, q)
+        p1v /= np.linalg.norm(p1v)
+        p2v = np.cross(q, p1v)
+        th = np.linspace(0, 2 * np.pi, n_az + 1)
+        c0 = rA + q * stations[-1]
+        ring = c0[None, :] + 0.5 * diameters[-1] * (
+            np.cos(th)[:, None] * p1v[None, :] + np.sin(th)[:, None] * p2v[None, :])
+        vs_l, c_l, n_l, a_l = [], [], [], []
+        for k in range(n_az):
+            vs = np.array([c0, ring[k], ring[k + 1], c0])
+            d1 = vs[2] - vs[0]
+            d2 = vs[1] - vs[0]
+            nvec = np.cross(d2, d1)
+            a = 0.5 * np.linalg.norm(nvec)
+            if a < 1e-10:
+                continue
+            nvec = nvec / (2 * a)
+            if np.dot(nvec, q) < 0:  # top cap outward = +q
+                nvec = -nvec
+                vs = vs[::-1]
+            vs_l.append(vs)
+            c_l.append(vs.mean(axis=0))
+            n_l.append(nvec)
+            a_l.append(a)
+        if vs_l:
+            verts = np.concatenate([verts, np.asarray(vs_l)])
+            cents = np.concatenate([cents, np.asarray(c_l)])
+            norms = np.concatenate([norms, np.asarray(n_l)])
+            areas = np.concatenate([areas, np.asarray(a_l)])
+    return verts, cents, norms, areas
+
+
+def mesh_rectangular(stations, sides, rA, q, p1, p2, dz_max=2.0, da_max=2.0):
+    """Quad panel mesh of a (possibly tapered) rectangular member's
+    wetted surface, clipped at z = 0, with end caps on submerged ends
+    (the reference meshes rectangular members in
+    member2pnl.meshRectangularMember:504-670).
+
+    stations : (n,) axial positions; sides : (n,2) (p1-width, p2-width);
+    rA : end-A coordinates; q, p1, p2 : member axes.
+    """
+    stations = np.asarray(stations, dtype=float)
+    sides = np.asarray(sides, dtype=float)
+    rA = np.asarray(rA, dtype=float)
+    q = np.asarray(q, dtype=float) / np.linalg.norm(q)
+    p1 = np.asarray(p1, dtype=float)
+    p2 = np.asarray(p2, dtype=float)
+
+    s_grid = [stations[0]]
+    for i in range(1, len(stations)):
+        seg = stations[i] - stations[i - 1]
+        if seg <= 0:
+            continue
+        nseg = max(1, int(np.ceil(seg / dz_max)))
+        s_grid += list(stations[i - 1] + seg * (np.arange(1, nseg + 1) / nseg))
+    s_grid = np.asarray(s_grid)
+    w1 = np.interp(s_grid, stations, sides[:, 0])
+    w2 = np.interp(s_grid, stations, sides[:, 1])
+
+    def perimeter(s, a, b, n_per_side):
+        """Points around the rectangle boundary at axial position s."""
+        c = rA + q * s
+        n1, n2 = n_per_side
+        # corners in (p1, p2) local coords, ccw
+        u = np.concatenate([
+            np.linspace(-a / 2, a / 2, n1 + 1)[:-1],
+            np.full(n2, a / 2),
+            np.linspace(a / 2, -a / 2, n1 + 1)[:-1],
+            np.full(n2, -a / 2)])
+        v = np.concatenate([
+            np.full(n1, -b / 2),
+            np.linspace(-b / 2, b / 2, n2 + 1)[:-1],
+            np.full(n1, b / 2),
+            np.linspace(b / 2, -b / 2, n2 + 1)[:-1]])
+        pts = c[None, :] + u[:, None] * p1[None, :] + v[:, None] * p2[None, :]
+        return np.vstack([pts, pts[:1]])
+
+    n1 = max(2, int(np.ceil(np.max(w1) / da_max)))
+    n2 = max(2, int(np.ceil(np.max(w2) / da_max)))
+    nper = 2 * (n1 + n2)
+
+    verts, cents, norms, areas = [], [], [], []
+
+    def add_quad(vs, outward_hint):
+        c = vs.mean(axis=0)
+        d1 = vs[2] - vs[0]
+        d2 = vs[3] - vs[1]
+        nvec = np.cross(d1, d2)
+        a = 0.5 * np.linalg.norm(nvec)
+        if a < 1e-10:
+            return
+        nvec = nvec / (2 * a)
+        if np.dot(nvec, outward_hint) < 0:
+            nvec = -nvec
+            vs = vs[::-1]
+        verts.append(vs)
+        cents.append(c)
+        norms.append(nvec)
+        areas.append(a)
+
+    for i in range(len(s_grid) - 1):
+        zA = rA[2] + q[2] * s_grid[i]
+        zB = rA[2] + q[2] * s_grid[i + 1]
+        if zA >= 0 and zB >= 0:
+            continue
+        sA, aA, bA = s_grid[i], w1[i], w2[i]
+        sB, aB, bB = s_grid[i + 1], w1[i + 1], w2[i + 1]
+        if zB > 0:
+            f = (0.0 - zA) / (zB - zA)
+            sB = sA + f * (s_grid[i + 1] - s_grid[i])
+            aB = aA + f * (w1[i + 1] - w1[i])
+            bB = bA + f * (w2[i + 1] - w2[i])
+        elif zA > 0:
+            f = (0.0 - zB) / (zA - zB)
+            sA = sB + f * (s_grid[i] - s_grid[i + 1])
+            aA = aB + f * (w1[i] - w1[i + 1])
+            bA = bB + f * (w2[i] - w2[i + 1])
+        ringA = perimeter(sA, aA, bA, (n1, n2))
+        ringB = perimeter(sB, aB, bB, (n1, n2))
+        cA = rA + q * sA
+        for k in range(nper):
+            vs = np.array([ringA[k], ringA[k + 1], ringB[k + 1], ringB[k]])
+            hint = vs.mean(axis=0) - (cA + q * np.dot(vs.mean(axis=0) - cA, q))
+            add_quad(vs, hint if np.linalg.norm(hint) > 1e-9 else p1)
+
+    # end caps (regular grids) on submerged ends
+    for end, sgn in ((0, -1.0), (-1, 1.0)):
+        z_end = rA[2] + q[2] * s_grid[end]
+        if z_end >= -1e-9:
+            continue
+        a, b = w1[end], w2[end]
+        c0 = rA + q * s_grid[end]
+        us = np.linspace(-a / 2, a / 2, n1 + 1)
+        vsv = np.linspace(-b / 2, b / 2, n2 + 1)
+        for iu in range(n1):
+            for ivv in range(n2):
+                quad = np.array([
+                    c0 + us[iu] * p1 + vsv[ivv] * p2,
+                    c0 + us[iu + 1] * p1 + vsv[ivv] * p2,
+                    c0 + us[iu + 1] * p1 + vsv[ivv + 1] * p2,
+                    c0 + us[iu] * p1 + vsv[ivv + 1] * p2,
+                ])
+                add_quad(quad, sgn * q)
+
+    if not verts:
+        return (np.zeros((0, 4, 3)), np.zeros((0, 3)), np.zeros((0, 3)),
+                np.zeros(0))
+    return (np.asarray(verts), np.asarray(cents), np.asarray(norms),
+            np.asarray(areas))
+
+
+def mesh_fowt(fs, dz_max=None, n_az=18, da_max=None):
+    """Combined wetted-surface panel mesh of a FOWT's potMod members at
+    the reference pose (the calcBEM meshing stage,
+    raft_fowt.py:1327-1344).  Members are meshed independently, as the
+    reference's member2pnl does (no boolean union).
+
+    Returns (vertices, centroids, normals, areas)."""
+    vs, cs, ns, as_ = [], [], [], []
+    for mem in fs.members:
+        if not mem.potMod:
+            continue
+        draft = -min(mem.rA0[2], mem.rB0[2])
+        if draft <= 0:
+            continue
+        dz = dz_max or max(min(3.0, draft / 4.0), 0.5)
+        da = da_max or dz
+        if mem.circular:
+            v, c, nr, a = mesh_cylinder_capped(
+                mem.stations, mem.d[:, 0], mem.rA0, mem.q0,
+                n_az=n_az, dz_max=dz)
+        else:
+            v, c, nr, a = mesh_rectangular(
+                mem.stations, mem.d, mem.rA0, mem.q0, mem.p10, mem.p20,
+                dz_max=dz, da_max=da)
+        if len(a):
+            vs.append(v)
+            cs.append(c)
+            ns.append(nr)
+            as_.append(a)
+    if not vs:
+        return (np.zeros((0, 4, 3)), np.zeros((0, 3)), np.zeros((0, 3)),
+                np.zeros(0))
+    return (np.concatenate(vs), np.concatenate(cs), np.concatenate(ns),
+            np.concatenate(as_))
+
+
+def read_pnl(path):
+    """Read a HAMS .pnl mesh (node-list + panel-connectivity layout, as
+    written by pyhams / the reference pipeline).
+
+    Returns (vertices (P,4,3), centroids (P,3), normals (P,3), areas (P,)).
+    Triangles are returned as degenerate quads (last vertex repeated).
+    Normals follow the file's winding; callers flip if needed.
+    """
+    nodes = {}
+    panels = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    mode = None
+    for ln in lines:
+        s = ln.split()
+        if not s:
+            continue
+        if ln.lstrip().startswith("#"):
+            low = ln.lower()
+            if "relation" in low or "elem" in low or "panel" in low:
+                mode = "panels"
+            elif "node" in low:
+                mode = "nodes"
+            else:
+                mode = None
+            continue
+        if mode == "nodes" and len(s) == 4:
+            try:
+                nodes[int(s[0])] = [float(s[1]), float(s[2]), float(s[3])]
+            except ValueError:
+                pass
+        elif mode == "panels" and len(s) >= 5:
+            try:
+                nv = int(s[1])
+                idx = [int(v) for v in s[2:2 + nv]]
+            except ValueError:
+                continue
+            if nv == 3:
+                idx = idx + [idx[2]]
+            panels.append(idx)
+    verts = np.array([[nodes[i] for i in p] for p in panels])
+    cents = verts.mean(axis=1)
+    d1 = verts[:, 2] - verts[:, 0]
+    d2 = verts[:, 3] - verts[:, 1]
+    nvec = np.cross(d1, d2)
+    areas = 0.5 * np.linalg.norm(nvec, axis=1)
+    norms = nvec / np.maximum(2 * areas, 1e-12)[:, None]
+    return verts, cents, norms, areas
+
+
 def write_pnl(path, vertices, title="raft_tpu panel mesh"):
     """Write panels in the HAMS .pnl format (member2pnl.writeMesh:280)."""
     n = len(vertices)

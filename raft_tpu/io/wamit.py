@@ -61,6 +61,36 @@ def read_wamit3(path):
     return freqs, heads, X
 
 
+def write_wamit1(path, w, A, B, rho=1025.0, ulen=1.0):
+    """Write added mass / radiation damping in the WAMIT .1 interchange
+    format (nondimensional: Abar = A/(rho ULEN^k), Bbar = B/(rho w ULEN^k);
+    ULEN exponent handled as in read_wamit1's inverse)."""
+    w = np.asarray(w)
+    with open(path, "w") as f:
+        for iw, wi in enumerate(w):
+            T = 2 * np.pi / wi
+            for i in range(6):
+                for j in range(6):
+                    f.write(f" {T: .6e} {i+1:5d} {j+1:5d}"
+                            f" {A[i, j, iw] / rho: .6e}"
+                            f" {B[i, j, iw] / (rho * wi): .6e}\n")
+
+
+def write_wamit3(path, w, headings_deg, X, rho=1025.0, g=9.81):
+    """Write excitation coefficients in the WAMIT .3 format
+    (X (nh, 6, nw) complex, dimensional; file stores X/(rho g))."""
+    w = np.asarray(w)
+    with open(path, "w") as f:
+        for iw, wi in enumerate(w):
+            T = 2 * np.pi / wi
+            for ih, h in enumerate(headings_deg):
+                for i in range(6):
+                    x = X[ih, i, iw] / (rho * g)
+                    f.write(f" {T: .6e} {h: .4f} {i+1:5d}"
+                            f" {abs(x): .6e} {np.degrees(np.angle(x)): .6e}"
+                            f" {x.real: .6e} {x.imag: .6e}\n")
+
+
 def _interp_freq(w_model, w_data, Y, pad_zero_freq=None):
     """Linear interpolation along the last axis onto the model grid,
     with an optional value prepended at w = 0 (the reference pads the

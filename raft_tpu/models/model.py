@@ -314,6 +314,7 @@ class Model:
             B_gyro=np.zeros((nDOF, nDOF)),
             A00=np.zeros((nw, max(fs.nrotors, 1))),
             B00=np.zeros((nw, max(fs.nrotors, 1))),
+            rotor_info=[None] * max(fs.nrotors, 1),
         )
         status = str(case.get("turbine_status", "operating"))
         if status != "operating" or not self.rotor_aero:
@@ -345,6 +346,8 @@ class Model:
             out["B_aero"] += np.einsum("ia,ijw,jb->abw", Tn, b, Tn)
             out["A00"][:, ir] = a[0, 0, :]
             out["B00"][:, ir] = b[0, 0, :]
+            out["rotor_info"][ir] = dict(
+                info, speed=speed, aeroServoMod=rprops.aeroServoMod)
             # gyroscopic damping (raft_fowt.py:1569-1581)
             Om_rpm = float(operating_point(rot, speed)[0])
             IO = info["q"] * (rprops.I_drivetrain * Om_rpm * 2 * np.pi / 60)
@@ -487,8 +490,10 @@ class Model:
 
     @property
     def bem(self):
-        """Lazy potential-flow coefficients from WAMIT-format files
-        (readHydro equivalent, raft_fowt.py:1444-1509)."""
+        """Lazy potential-flow coefficients: WAMIT-format files when the
+        design points at them (readHydro equivalent,
+        raft_fowt.py:1444-1509), otherwise the NATIVE panel solver runs
+        on the potMod members (calcBEM equivalent, :1288-1442)."""
         if not hasattr(self, "_bem"):
             self._bem = None
             fs = self.fowtList[0]
@@ -500,7 +505,63 @@ class Model:
                     path, self.w, fs.rho_water, fs.g,
                     r_ref=fs.node_r0[fs.root_id],
                 )
+            elif any(m.potMod for m in fs.members):
+                self._bem = self.run_bem()
         return self._bem
+
+    def run_bem(self, ifowt=0, w_bem=None, headings=None, save_dir=None,
+                n_az=None, dz_max=None, force=False, workers=None):
+        """Run the native free-surface panel solver on the FOWT's potMod
+        members and read the coefficients back through the WAMIT
+        interchange files (mirrors the reference's HAMS round trip:
+        mesh -> run -> write .1/.3 -> readHydro, raft_fowt.py:1288-1509).
+
+        Results are cached in ``save_dir`` (default
+        ``./_bem_cache/<design name>``); pass force=True to re-run.
+        Returns the same dict structure as WAMIT-file loading.
+        """
+        import os
+
+        from raft_tpu.io.panels import mesh_fowt
+        from raft_tpu.io.wamit import (load_bem_coefficients, write_wamit1,
+                                       write_wamit3)
+
+        fs = self.fowtList[ifowt]
+        settings = self.design.get("settings", {}) or {}
+        name = str(self.design.get("name", "design")).replace(" ", "_")[:40]
+        if save_dir is None:
+            save_dir = os.environ.get(
+                "RAFT_TPU_BEM_DIR", os.path.join(os.getcwd(), "_bem_cache"))
+        os.makedirs(save_dir, exist_ok=True)
+        prefix = os.path.join(save_dir, name)
+
+        if w_bem is None:
+            dw = float(coerce(settings, "dw_BEM", default=0.0) or 0.0)
+            wMax = float(coerce(settings, "wMax_BEM", default=0.0) or 0.0)
+            if dw <= 0:
+                dw = max((self.w[-1] - self.w[0]) / 24.0, 1e-3)
+            if wMax <= 0:
+                wMax = float(self.w[-1])
+            w_bem = np.arange(dw, wMax + 0.5 * dw, dw)
+        if headings is None:
+            headings = np.arange(0.0, 360.0, 45.0)
+
+        if force or not os.path.exists(prefix + ".1"):
+            n_az_v = n_az or int(coerce(settings, "nAz_BEM", default=18, dtype=int))
+            dz_v = dz_max or (coerce(settings, "dz_BEM", default=0.0) or None)
+            v, c, nrm, a = mesh_fowt(fs, dz_max=dz_v, n_az=n_az_v)
+            if len(a) == 0:
+                return None
+            from raft_tpu.native import solve_bem
+
+            A, B, X = solve_bem(v, c, nrm, a, w_bem, headings_deg=headings,
+                                depth=self.depth, rho=fs.rho_water, g=fs.g,
+                                ref=(0.0, 0.0, 0.0), workers=workers)
+            write_wamit1(prefix + ".1", w_bem, A, B, rho=fs.rho_water)
+            write_wamit3(prefix + ".3", w_bem, headings, X,
+                         rho=fs.rho_water, g=fs.g)
+        return load_bem_coefficients(
+            prefix, self.w, fs.rho_water, fs.g, r_ref=fs.node_r0[fs.root_id])
 
     def bem_matrices(self, ifowt=0):
         """Potential-flow added mass / radiation damping on the model

@@ -44,6 +44,108 @@ def _load():
     return _lib
 
 
+def _load_freq(lib):
+    if getattr(lib, "_freq_ready", False):
+        return
+    lib.panel_solve_frequency.restype = ctypes.c_int
+    dbl = lambda: np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+    lib.panel_solve_frequency.argtypes = [
+        ctypes.c_int, dbl(), dbl(), dbl(), dbl(),             # mesh
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        dbl(),                                                # ref
+        ctypes.c_int, dbl(),                                  # headings
+        ctypes.c_int, ctypes.c_int, dbl(), dbl(), dbl(), dbl(),  # tables
+        dbl(), dbl(), dbl(),                                  # outputs
+    ]
+    lib._freq_ready = True
+
+
+def solve_bem_frequency(vertices, centroids, normals, areas, omega,
+                        headings_rad=(0.0,), depth=np.inf, rho=1025.0,
+                        g=9.81, ref=(0.0, 0.0, 0.0)):
+    """Radiation + diffraction at one frequency from the native panel
+    solver with the free-surface wave Green function.
+
+    The wave term uses the infinite-depth Green function evaluated at
+    the finite-depth wavenumber k0(omega, depth) ('equivalent
+    wavenumber' mapping: the far-field wavelength is exact, the bottom
+    no-flux condition is approximated — good for depth >> draft, the
+    regime of every potMod design in the reference suite).
+
+    Returns (A (6,6), B (6,6), X (nh, 6) complex).
+    """
+    from raft_tpu.native.green_table import build_tables
+    from raft_tpu.ops.waves import wave_number
+
+    lib = _load()
+    _load_freq(lib)
+    t = build_tables()
+
+    if np.isfinite(depth):
+        K = float(np.asarray(wave_number(np.asarray([omega]), depth, g=g))[0])
+    else:
+        K = omega * omega / g
+
+    n = len(areas)
+    nh = len(headings_rad)
+    A = np.zeros(36)
+    B = np.zeros(36)
+    X = np.zeros(nh * 12)
+    rc = lib.panel_solve_frequency(
+        n,
+        np.ascontiguousarray(vertices, dtype=np.float64).reshape(-1),
+        np.ascontiguousarray(centroids, dtype=np.float64).reshape(-1),
+        np.ascontiguousarray(normals, dtype=np.float64).reshape(-1),
+        np.ascontiguousarray(areas, dtype=np.float64),
+        float(K), float(omega), float(rho), float(g),
+        np.ascontiguousarray(ref, dtype=np.float64),
+        nh, np.ascontiguousarray(headings_rad, dtype=np.float64),
+        len(t["lnd"]), len(t["alpha"]),
+        np.ascontiguousarray(t["lnd"]), np.ascontiguousarray(t["alpha"]),
+        np.ascontiguousarray(t["L"]).reshape(-1),
+        np.ascontiguousarray(t["M"]).reshape(-1),
+        A, B, X,
+    )
+    if rc != 0:
+        raise RuntimeError("panel frequency solve failed (singular system)")
+    Xc = X.reshape(nh, 6, 2)
+    return A.reshape(6, 6), B.reshape(6, 6), Xc[..., 0] + 1j * Xc[..., 1]
+
+
+def solve_bem(vertices, centroids, normals, areas, omegas,
+              headings_deg=(0.0,), depth=np.inf, rho=1025.0, g=9.81,
+              ref=(0.0, 0.0, 0.0), workers=None):
+    """Frequency sweep: A (6,6,nw), B (6,6,nw), X (nh, 6, nw) complex.
+
+    The native calcBEM-equivalent (reference runs pyHAMS here,
+    raft_fowt.py:1288-1442).  Frequencies are independent dense solves;
+    they run in a thread pool (the ctypes call releases the GIL)."""
+    import concurrent.futures as cf
+    import os as _os
+
+    omegas = np.asarray(omegas, dtype=float)
+    nh = len(headings_deg)
+    heads = np.deg2rad(np.asarray(headings_deg, dtype=float))
+    A = np.zeros((6, 6, len(omegas)))
+    B = np.zeros((6, 6, len(omegas)))
+    X = np.zeros((nh, 6, len(omegas)), dtype=complex)
+
+    # table built once up front (not thread-safe lazily)
+    from raft_tpu.native.green_table import build_tables
+    build_tables()
+    _load_freq(_load())
+
+    def one(iw):
+        A[:, :, iw], B[:, :, iw], X[:, :, iw] = solve_bem_frequency(
+            vertices, centroids, normals, areas, omegas[iw], heads,
+            depth, rho, g, ref)
+
+    workers = workers or min(8, max(1, (_os.cpu_count() or 2) - 1))
+    with cf.ThreadPoolExecutor(max_workers=workers) as ex:
+        list(ex.map(one, range(len(omegas))))
+    return A, B, X
+
+
 def radiation_added_mass(vertices, centroids, normals, areas, mirror=-1,
                          rho=1025.0, ref=(0.0, 0.0, 0.0)):
     """6x6 frequency-limit added-mass matrix from the native panel solver.

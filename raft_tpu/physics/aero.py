@@ -518,6 +518,12 @@ def calc_aero(rot: RotorAeroModel, rprops, case, w, speed=None,
     a = np.zeros((6, 6, nw))
     b = np.zeros((6, 6, nw))
     f = np.zeros((6, nw), dtype=complex)
+    # rotor-channel transfer-function data (raft_rotor.py:926-947,
+    # consumed by saveTurbineOutputs raft_fowt.py:2630-2688)
+    chan = dict(C=np.zeros(nw, dtype=complex), kp_beta=0.0, ki_beta=0.0,
+                kp_tau=0.0, ki_tau=0.0,
+                aero_torque=float(loads[3]),
+                aero_power=float(loads[3] * Om * 2 * np.pi / 60.0))
 
     if rprops.aeroServoMod == 1:
         b_in = np.zeros((6, 6, nw))
@@ -533,12 +539,17 @@ def calc_aero(rot: RotorAeroModel, rprops, case, w, speed=None,
         kp_tau = rot.kp_tau * (kp_beta == 0)
         ki_tau = rot.ki_tau * (ki_beta == 0)
         zhub = rprops.Zhub
+        # characteristic denominator + azimuth transfer function
+        # (raft_rotor.py:926-931): phi_w = C * XiHub in the outputs stage
+        Dden = (rot.I_drivetrain * w**2
+                + (dQ_dOm + kp_beta * dQ_dPi - rot.Ng * kp_tau) * 1j * w
+                + ki_beta * dQ_dPi - rot.Ng * ki_tau)
+        chan.update(
+            C=1j * w * (dQ_dU - rot.k_float * dQ_dPi / zhub) / Dden,
+            kp_beta=float(kp_beta), ki_beta=float(ki_beta),
+            kp_tau=float(kp_tau), ki_tau=float(ki_tau))
         # torque-to-thrust transfer function (raft_rotor.py:959-967)
-        H_QT = ((dT_dOm + kp_beta * dT_dPi) * 1j * w + ki_beta * dT_dPi) / (
-            rot.I_drivetrain * w**2
-            + (dQ_dOm + kp_beta * dQ_dPi - rot.Ng * kp_tau) * 1j * w
-            + ki_beta * dQ_dPi - rot.Ng * ki_tau
-        )
+        H_QT = ((dT_dOm + kp_beta * dT_dPi) * 1j * w + ki_beta * dT_dPi) / Dden
         f2 = (dT_dU - H_QT * dQ_dU) * V_w
         b2 = np.real(dT_dU - rot.k_float * dT_dPi / zhub
                      - H_QT * (dQ_dU - rot.k_float * dQ_dPi / zhub))
@@ -559,7 +570,8 @@ def calc_aero(rot: RotorAeroModel, rprops, case, w, speed=None,
         b[:, :, iw] = np.asarray(tf.translate_matrix_6to6(b[:, :, iw], r_off))
         f[:, iw] = np.asarray(tf.transform_force_6(jnp.asarray(f[:, iw]), jnp.asarray(r_off)))
     return f0, f, a, b, dict(loads=loads, dT=dT, dQ=dQ, Omega_rpm=float(Om),
-                             pitch_deg=float(pit), V_w=V_w, R_q=R_q, q=q)
+                             pitch_deg=float(pit), V_w=V_w, R_q=R_q, q=q,
+                             **chan)
 
 
 # ------------------------------------------------- traced aero-servo path
