@@ -347,7 +347,8 @@ class Model:
             out["A00"][:, ir] = a[0, 0, :]
             out["B00"][:, ir] = b[0, 0, :]
             out["rotor_info"][ir] = dict(
-                info, speed=speed, aeroServoMod=rprops.aeroServoMod)
+                info, speed=speed, aeroServoMod=rprops.aeroServoMod,
+                Ng=rot.Ng)
             # gyroscopic damping (raft_fowt.py:1569-1581)
             Om_rpm = float(operating_point(rot, speed)[0])
             IO = info["q"] * (rprops.I_drivetrain * Om_rpm * 2 * np.pi / 60)
@@ -658,6 +659,71 @@ class Model:
         modes = eigenvectors[:, ind_list]
         return fns, modes
 
+    def calc_outputs(self):
+        """System-property and eigen outputs (Model.calcOutputs
+        equivalent, raft_model.py:1319-1360): fills
+        ``results['properties']`` and ``results['eigen']`` and returns
+        the results dict."""
+        from raft_tpu.ops import transforms as tf
+
+        fs = self.fowtList[0]
+        stat = self.statics(0)
+        if not hasattr(self, "results"):
+            self.results = {}
+        props = self.results.setdefault("properties", {})
+
+        X0_unloaded = np.asarray(self.solve_statics(None))
+        force, stiff = self._mooring_closures()
+        F_moor0 = np.asarray(force(jnp.asarray(X0_unloaded)))[:6]
+        C_moor0 = np.asarray(stiff(jnp.asarray(X0_unloaded)))[:6, :6]
+
+        m_shell = float(sum(m.mshell for m in fs.members
+                            if m.part_of == "platform"))
+        props["tower mass"] = np.asarray(stat["mtower"])
+        props["tower CG"] = np.asarray(stat["rCG_tow"])
+        props["substructure mass"] = float(stat["m_sub"])
+        props["substructure CG"] = np.asarray(stat["rCG_sub"])
+        props["shell mass"] = m_shell
+        props["ballast mass"] = np.asarray(stat["m_ballast"])
+        props["ballast densities"] = np.asarray(stat["pb"])
+        props["total mass"] = float(np.asarray(stat["M_struc"])[0, 0])
+        props["total CG"] = np.asarray(stat["rCG"])
+        # substructure inertias about its own CG (raft_model.py:1338-1340)
+        M_subCG = np.asarray(tf.translate_matrix_6to6(
+            jnp.asarray(stat["M_sub6"]), -jnp.asarray(stat["rCG_sub"])))
+        props["roll inertia at subCG"] = M_subCG[3, 3]
+        props["pitch inertia at subCG"] = M_subCG[4, 4]
+        props["yaw inertia at subCG"] = M_subCG[5, 5]
+        props["buoyancy (pgV)"] = fs.rho_water * fs.g * float(stat["V"])
+        props["center of buoyancy"] = np.asarray(stat["rCB"])
+        props["C hydrostatic"] = np.asarray(stat["C_hydro"])[:6, :6]
+        props["C system"] = (
+            np.asarray(stat["C_struc"] + stat["C_hydro"]
+                       + stat["C_elast"])[:6, :6] + C_moor0)
+        props["F_lines0"] = F_moor0
+        props["C_lines0"] = C_moor0
+        props["M support structure"] = np.asarray(stat["M_struc_sub"])[:6, :6]
+        A_BEM, _ = self.bem_matrices(0)
+        props["A support structure"] = np.asarray(
+            self.hydro[0].hc0["A_hydro"])[:6, :6] + np.asarray(A_BEM[:6, :6, -1])
+        props["C support structure"] = (
+            np.asarray(stat["C_struc_sub"] + stat["C_hydro"])[:6, :6] + C_moor0)
+
+        fns, modes = self.solve_eigen()
+        self.results["eigen"] = {"frequencies": np.asarray(fns),
+                                 "modes": np.asarray(modes)}
+        return self.results
+
+    def write_modes_json(self, filename, fns=None, modes=None, ifowt=0):
+        """Write eigenmodes in the viz3Danim JSON layout
+        (FOWT.write_modes_json equivalent, raft_fowt.py:2889-3070)."""
+        from raft_tpu.models.outputs import write_modes_json
+
+        if fns is None or modes is None:
+            fns, modes = self.solve_eigen()
+        write_modes_json(self, filename, np.asarray(fns), np.asarray(modes),
+                         ifowt=ifowt)
+
     # ---------------------------------------------------------- case driver
     def analyze_cases(self):
         """Run every case in the design's case table and collect channel
@@ -688,6 +754,7 @@ class Model:
                     info["infos"][i]["S"], info["infos"][i]["zeta"],
                     A_aero=tc_i["A00"].T, B_aero=tc_i["B00"].T,
                     f_aero0=tc_i["f_aero0"], ifowt=i,
+                    rotor_info=tc_i.get("rotor_info"),
                 )
                 self.results["case_metrics"][iCase][i] = metrics
         return self.results

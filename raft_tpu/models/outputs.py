@@ -40,8 +40,84 @@ def mooring_tension_vector(ms, r6):
     return jnp.concatenate([TA, TB])
 
 
+def write_modes_json(model, filename, fns, modes, ifowt=0):
+    """Eigenmode JSON for viz3Danim (FOWT.write_modes_json equivalent,
+    raft_fowt.py:2889-3070): real structural nodes plus virtual nodes
+    along rigid members' stations, element connectivity with diameters,
+    and per-mode nodal displacements mapped through the reduction T."""
+    import json
+
+    fs = model.fowtList[ifowt]
+    T = np.asarray(fs.T)
+    node_r0 = np.asarray(fs.node_r0)
+
+    nodes = [list(map(float, r)) for r in node_r0]
+    connectivity = []
+    elem_props = []
+    virtual = {}  # virtual node idx -> (real node id, offset)
+
+    for im, mem in enumerate(fs.members):
+        n0 = int(fs.member_node[im])
+        if mem.mtype == "rigid":
+            stations_r = [mem.rA0 + mem.q0 * s for s in mem.stations]
+            prev = n0
+            for i in range(len(mem.stations) - 1):
+                rB = stations_r[i + 1]
+                nodes.append(list(map(float, rB)))
+                n2 = len(nodes) - 1
+                virtual[n2] = (n0, rB - node_r0[n0])
+                if i == 0:
+                    n1 = n0
+                else:
+                    n1 = prev
+                connectivity.append([int(n1), int(n2)])
+                d = 0.5 * (np.max(mem.d[i]) + np.max(mem.d[i + 1]))
+                elem_props.append({"shape": "cylinder", "type": 1,
+                                   "Diam": float(d)})
+                prev = n2
+        else:  # beam: strip nodes are real structural nodes
+            for i in range(mem.ns - 1):
+                connectivity.append([n0 + i, n0 + i + 1])
+                if mem.dorsl_node_ext is not None:
+                    d = 0.5 * (np.max(mem.dorsl_node_ext[i])
+                               + np.max(mem.dorsl_node_ext[i + 1]))
+                else:
+                    d = float(np.max(mem.d))
+                elem_props.append({"shape": "cylinder", "type": 1,
+                                   "Diam": float(d)})
+
+    modes_list = []
+    for i in range(modes.shape[1]):
+        full = T @ np.asarray(modes[:, i])
+        displ = []
+        for idx in range(len(nodes)):
+            if idx < len(node_r0):
+                displ.append([float(full[6 * idx + k]) for k in range(3)])
+            else:
+                nid, off = virtual[idx]
+                t = full[6 * nid:6 * nid + 3]
+                rot = full[6 * nid + 3:6 * nid + 6]
+                displ.append(list(map(float, t + np.cross(rot, off))))
+        modes_list.append({"name": f"FEM{i+1}",
+                           "frequency": float(fns[i]),
+                           "omega": float(fns[i] * 2 * np.pi),
+                           "Displ": displ})
+
+    doc = {
+        "writer": "raft_tpu",
+        "fileKind": "Modes",
+        "groundLevel": float(fs.depth),
+        "Connectivity": connectivity,
+        "Nodes": nodes,
+        "ElemProps": elem_props,
+        "Modes": modes_list,
+    }
+    with open(filename, "w") as f:
+        json.dump(doc, f)
+
+
 def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
-                    f_aero0=None, ifowt=0):
+                    f_aero0=None, ifowt=0, rotor_info=None):
     """Channel statistics for one case and one FOWT.
 
     Xi : (nWaves+1, nDOF, nw) response amplitudes of THIS FOWT (last
@@ -216,4 +292,50 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
 
     # wave elevation PSD (raft_fowt.py:2608)
     results["wave_PSD"] = get_psd(jnp.asarray(zeta), dw, axis=0)
+
+    # ----- rotor response channels (raft_fowt.py:2609-2688): rotor
+    # azimuth/speed/torque/blade-pitch spectra through the control
+    # transfer function C applied to the hub fore-aft motion, with the
+    # turbulence inflow V_w driving the rotor-excitation source row
+    for key in ("omega", "torque", "bPitch"):
+        for suf in ("avg", "std", "max", "min"):
+            results[f"{key}_{suf}"] = np.zeros(nrot)
+        results[f"{key}_PSD"] = np.zeros((model.nw, nrot))
+    results["power_avg"] = np.zeros(nrot)
+    RADPS2RPM = 60.0 / (2 * np.pi)
+    for ir in range(nrot):
+        ri = rotor_info[ir] if rotor_info else None
+        if ri is None or ri.get("aeroServoMod", 0) <= 1 or ri.get("speed", 0) <= 0:
+            continue
+        node = int(fs.rotor_node[ir])
+        XiHub = jnp.einsum("ia,haw->hiw", model.hydro[ifowt].Tn[node], Xi)[:, 0, :]
+        C = jnp.asarray(ri["C"])  # (nw,)
+        V_w = jnp.asarray(ri["V_w"])
+        phi_w = C[None, :] * XiHub
+        phi_w = phi_w.at[-1].set(C * (XiHub[-1] - V_w / (1j * w)))
+        omega_w = 1j * w * phi_w
+        torque_w = (1j * w * ri["kp_tau"] + ri["ki_tau"]) * phi_w
+        bPitch_w = (1j * w * ri["kp_beta"] + ri["ki_beta"]) * phi_w
+
+        results["omega_avg"][ir] = ri["Omega_rpm"]
+        results["omega_std"][ir] = RADPS2RPM * float(get_rms(omega_w))
+        # note the reference's 2-sigma band for rotor speed (raft_fowt.py:2656)
+        results["omega_max"][ir] = results["omega_avg"][ir] + 2 * results["omega_std"][ir]
+        results["omega_min"][ir] = results["omega_avg"][ir] - 2 * results["omega_std"][ir]
+        results["omega_PSD"][:, ir] = RADPS2RPM**2 * np.asarray(
+            get_psd(omega_w, dw, axis=0))
+
+        Ng = ri.get("Ng", 1.0) or 1.0
+        results["torque_avg"][ir] = ri["aero_torque"] / Ng
+        results["torque_std"][ir] = float(get_rms(torque_w))
+        results["torque_PSD"][:, ir] = np.asarray(get_psd(torque_w, dw, axis=0))
+
+        results["power_avg"][ir] = ri["aero_power"]
+
+        results["bPitch_avg"][ir] = ri["pitch_deg"]
+        results["bPitch_std"][ir] = RAD2DEG * float(get_rms(bPitch_w))
+        results["bPitch_PSD"][:, ir] = RAD2DEG**2 * np.asarray(
+            get_psd(bPitch_w, dw, axis=0))
+
+        results["wind_PSD"] = np.asarray(get_psd(V_w, dw))
     return results

@@ -46,6 +46,15 @@ class MooringSystem:
     w: np.ndarray           # (nL,) submerged weight per length [N/m]
     EA: np.ndarray          # (nL,) axial stiffness [N]
     depth: float
+    # line-dynamics properties (lumped-mass moorMod 1/2); MoorDyn-style
+    # defaults filled by build_mooring when the design omits them
+    m_lin: np.ndarray | None = None   # (nL,) structural mass per length
+    d_vol: np.ndarray | None = None   # (nL,) volume-equivalent diameter
+    Cd: np.ndarray | None = None      # transverse drag
+    Ca: np.ndarray | None = None      # transverse added mass
+    CdAx: np.ndarray | None = None    # tangential drag
+    CaAx: np.ndarray | None = None    # tangential added mass
+    moorMod: int = 0
 
     @property
     def n_lines(self):
@@ -66,6 +75,7 @@ def build_mooring(mooring, rho_water=1025.0, g=9.81, x_ref=0.0, y_ref=0.0,
     points = {p["name"]: p for p in mooring["points"]}
 
     r_anchor, r_fair, L, w, EA = [], [], [], [], []
+    m_lin_l, d_l, Cd_l, Ca_l, CdAx_l, CaAx_l = [], [], [], [], [], []
     for line in mooring["lines"]:
         pA = points[line["endA"]]
         pB = points[line["endB"]]
@@ -82,6 +92,12 @@ def build_mooring(mooring, rho_water=1025.0, g=9.81, x_ref=0.0, y_ref=0.0,
         L.append(float(line["length"]))
         w.append((m_lin - rho_water * np.pi / 4 * d**2) * g)
         EA.append(float(lt["stiffness"]))
+        m_lin_l.append(m_lin)
+        d_l.append(d)
+        Cd_l.append(float(coerce(lt, "transverse_drag", default=1.2)))
+        Ca_l.append(float(coerce(lt, "transverse_added_mass", default=1.0)))
+        CdAx_l.append(float(coerce(lt, "tangential_drag", default=0.05)))
+        CaAx_l.append(float(coerce(lt, "tangential_added_mass", default=0.0)))
 
     r_anchor = np.array(r_anchor)
     r_fair = np.array(r_fair)
@@ -100,6 +116,13 @@ def build_mooring(mooring, rho_water=1025.0, g=9.81, x_ref=0.0, y_ref=0.0,
         w=np.array(w),
         EA=np.array(EA),
         depth=depth,
+        m_lin=np.array(m_lin_l),
+        d_vol=np.array(d_l),
+        Cd=np.array(Cd_l),
+        Ca=np.array(Ca_l),
+        CdAx=np.array(CdAx_l),
+        CaAx=np.array(CaAx_l),
+        moorMod=int(coerce(mooring, "moorMod", default=0, dtype=int)),
     )
 
 

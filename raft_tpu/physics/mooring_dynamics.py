@@ -1,0 +1,331 @@
+"""Frequency-domain lumped-mass mooring line dynamics (moorMod 1/2).
+
+The reference delegates dynamic mooring to MoorPy's lumped-mass
+frequency-domain solver (``line.dynamicSolve`` /
+``getCoupledDynamicMatrices``, consumed at
+``/root/reference/raft/raft_model.py:379-404``,
+``raft_fowt.py:2281-2289``, ``helpers.py:786``).  Here the same physics
+is built TPU-first:
+
+* the line is discretised into lumped nodes along its *static elastic
+  catenary* profile (positions + mean tensions from the same closed
+  forms as the quasi-static module);
+* per-node 3-DOF equations carry structural + added mass, axial EA and
+  geometric (mean-tension) stiffness, stochastically linearised Morison
+  drag, and wave-kinematics excitation;
+* the boundary nodes move with the platform fairlead RAO (anchor end
+  fixed); grounded nodes are vertically supported by the seabed;
+* the interior system solves as ONE batched complex solve over the
+  frequency axis — ``jnp.linalg.solve`` on (nw, n_int, n_int) — with
+  the drag linearisation as a small fixed-point loop, exactly the
+  pattern of the platform dynamics kernel.
+
+Outputs: dynamic tension amplitudes along the line (the moorMod 1
+tension post-processing) and the condensed fairlead impedance Z(w)
+(3x3 per frequency) whose real/imag parts are the moorMod 2 dynamic
+mooring stiffness/damping felt by the platform.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ops import waves as wv
+from raft_tpu.physics.mooring import solve_catenary
+
+
+def line_static_shape(r_anchor, r_fair, L, w_lin, EA, n_seg=24,
+                      can_ground=True):
+    """Node positions and mean tensions along the static elastic
+    catenary (anchor = node 0, fairlead = node n_seg).
+
+    Returns (r_nodes (n+1,3), T_nodes (n+1,), grounded (n+1,) bool).
+    """
+    r_anchor = np.asarray(r_anchor, dtype=float)
+    r_fair = np.asarray(r_fair, dtype=float)
+    dv = r_fair - r_anchor
+    XF = float(np.hypot(dv[0], dv[1]))
+    ZF = float(dv[2])
+    uh = dv[:2] / max(XF, 1e-9)
+
+    HF, VF = solve_catenary(XF, ZF, L, w_lin, EA, can_ground=can_ground)
+    HF, VF = float(HF), float(VF)
+
+    s = np.linspace(0.0, L, n_seg + 1)
+    VA = VF - w_lin * L
+    LB = max(L - VF / w_lin, 0.0) if can_ground else 0.0
+    grounded = s <= LB + 1e-9
+
+    x = np.zeros_like(s)
+    z = np.zeros_like(s)
+    T = np.zeros_like(s)
+    for i, si in enumerate(s):
+        if can_ground and VF < w_lin * L:   # partly grounded
+            if si <= LB:
+                x[i] = si * (1.0 + HF / EA)
+                z[i] = 0.0
+                T[i] = HF
+            else:
+                sp = si - LB
+                V = w_lin * sp
+                x[i] = (LB * (1.0 + HF / EA)
+                        + (HF / w_lin) * np.arcsinh(V / HF) + HF * sp / EA)
+                z[i] = ((HF / w_lin) * (np.sqrt(1 + (V / HF) ** 2) - 1.0)
+                        + V**2 / (2 * EA * w_lin))
+                T[i] = np.hypot(HF, V)
+        else:                                # fully suspended
+            V = VA + w_lin * si
+            x[i] = ((HF / w_lin) * (np.arcsinh(V / HF) - np.arcsinh(VA / HF))
+                    + HF * si / EA)
+            z[i] = ((HF / w_lin) * (np.sqrt(1 + (V / HF) ** 2)
+                                    - np.sqrt(1 + (VA / HF) ** 2))
+                    + (VA * si + 0.5 * w_lin * si**2) / EA)
+            T[i] = np.hypot(HF, V)
+
+    r_nodes = np.zeros((n_seg + 1, 3))
+    r_nodes[:, 0] = r_anchor[0] + x * uh[0]
+    r_nodes[:, 1] = r_anchor[1] + x * uh[1]
+    r_nodes[:, 2] = r_anchor[2] + z
+    return r_nodes, T, grounded
+
+
+def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
+                  w_arr, k_arr, zeta, beta, depth, rho=1025.0, g=9.81,
+                  Cd=1.2, Ca=1.0, CdAx=0.05, CaAx=0.0,
+                  RAO_A=None, RAO_B=None, n_drag_iter=5):
+    """Frequency-domain lumped-mass solve for one line.
+
+    r_nodes/T_nodes/grounded : static discretisation from
+    :func:`line_static_shape` (n+1 nodes).
+    zeta : (nw,) complex wave component amplitudes; beta heading [rad].
+    RAO_A/RAO_B : (3, nw) complex end-motion amplitudes (None = fixed).
+
+    Returns dict with
+      T_amp   : (n+1, nw) dynamic tension amplitudes,
+      Z_fair  : (nw, 3, 3) condensed impedance at end B,
+      X       : (n-1, 3, nw) interior node motion amplitudes.
+    """
+    r_nodes = np.asarray(r_nodes)
+    n = len(r_nodes) - 1          # segments
+    n_int = n - 1                 # interior nodes
+    nw = len(w_arr)
+    w_arr = jnp.asarray(w_arr)
+    l0 = L / n
+
+    seg_vec = r_nodes[1:] - r_nodes[:-1]
+    l_seg = np.linalg.norm(seg_vec, axis=1)
+    t_seg = seg_vec / np.maximum(l_seg, 1e-9)[:, None]
+    T_seg = 0.5 * (T_nodes[1:] + T_nodes[:-1])
+
+    A_c = np.pi / 4 * d_vol**2
+
+    # ---- per-segment 3x3 stiffness: axial EA + geometric tension
+    tt = np.einsum("si,sj->sij", t_seg, t_seg)
+    I3 = np.eye(3)
+    k_seg = (EA / l0) * tt + (T_seg / np.maximum(l_seg, 1e-9))[:, None, None] * (I3 - tt)
+
+    # ---- assemble interior stiffness and end-coupling blocks
+    K = np.zeros((3 * n_int, 3 * n_int))
+    K_A = np.zeros((3 * n_int, 3))   # coupling to node 0 (anchor end)
+    K_B = np.zeros((3 * n_int, 3))   # coupling to node n (fairlead end)
+    for si in range(n):
+        iL, iR = si - 1, si          # interior indices of segment ends
+        k = k_seg[si]
+        if 0 <= iL < n_int:
+            K[3 * iL:3 * iL + 3, 3 * iL:3 * iL + 3] += k
+        if 0 <= iR < n_int:
+            K[3 * iR:3 * iR + 3, 3 * iR:3 * iR + 3] += k
+        if 0 <= iL < n_int and 0 <= iR < n_int:
+            K[3 * iL:3 * iL + 3, 3 * iR:3 * iR + 3] -= k
+            K[3 * iR:3 * iR + 3, 3 * iL:3 * iL + 3] -= k
+        if iL == -1 and 0 <= iR < n_int:
+            K_A[3 * iR:3 * iR + 3] -= k
+        if iR == n - 1 and 0 <= iL < n_int:
+            K_B[3 * iL:3 * iL + 3] -= k
+
+    # ---- nodal mass + added mass (node tangent = mean of segments)
+    t_node = np.zeros((n + 1, 3))
+    t_node[0] = t_seg[0]
+    t_node[-1] = t_seg[-1]
+    t_node[1:-1] = t_seg[:-1] + t_seg[1:]
+    t_node /= np.maximum(np.linalg.norm(t_node, axis=1), 1e-9)[:, None]
+    ttn = np.einsum("ni,nj->nij", t_node, t_node)
+    M_node = (m_lin * l0 * I3[None]
+              + rho * A_c * l0 * (Ca * (I3[None] - ttn) + CaAx * ttn))
+
+    M = np.zeros((3 * n_int, 3 * n_int))
+    for i in range(n_int):
+        M[3 * i:3 * i + 3, 3 * i:3 * i + 3] = M_node[i + 1]
+
+    # seabed support: grounded interior nodes are vertically clamped
+    # (unilateral contact linearised about the resting state)
+    clamp = np.zeros(3 * n_int, dtype=bool)
+    for i in range(n_int):
+        if grounded[i + 1]:
+            clamp[3 * i + 2] = True
+
+    # ---- wave kinematics at the nodes
+    zeta = jnp.asarray(zeta, dtype=complex)
+    u, ud, _ = wv.wave_kinematics(
+        zeta[None, :], beta, w_arr, jnp.asarray(k_arr), depth,
+        jnp.asarray(r_nodes), rho=rho, g=g)   # (n+1, 3, nw)
+
+    # end-motion amplitudes
+    XA = jnp.zeros((3, nw), dtype=complex) if RAO_A is None else jnp.asarray(RAO_A)
+    XB = jnp.zeros((3, nw), dtype=complex) if RAO_B is None else jnp.asarray(RAO_B)
+
+    K_j = jnp.asarray(K)
+    M_j = jnp.asarray(M)
+    K_A_j = jnp.asarray(K_A)
+    K_B_j = jnp.asarray(K_B)
+    clamp_j = jnp.asarray(clamp)
+
+    # Morison inertial excitation on interior nodes
+    F_in = (rho * A_c * l0) * (
+        (1.0 + Ca) * (ud[1:-1] - jnp.einsum("nij,njw->niw", ttn[1:-1], ud[1:-1]))
+        + (1.0 + CaAx) * jnp.einsum("nij,njw->niw", ttn[1:-1], ud[1:-1])
+    )  # (n_int, 3, nw)
+
+    drag_c = 0.5 * rho * d_vol * l0
+
+    def solve_with_B(Bn):
+        """Assemble+solve given per-node 3x3 drag matrices (n_int,3,3)."""
+        Bfull = jnp.zeros((3 * n_int, 3 * n_int))
+        for i in range(n_int):
+            Bfull = Bfull.at[3 * i:3 * i + 3, 3 * i:3 * i + 3].set(Bn[i])
+        F_drag = jnp.einsum("nij,njw->niw", Bn, u[1:-1])
+        F = (F_in + F_drag).transpose(2, 0, 1).reshape(nw, 3 * n_int)
+        F = F - jnp.einsum("ij,jw->wi", K_A_j, XA) - jnp.einsum("ij,jw->wi", K_B_j, XB)
+        D = (K_j[None] + 1j * w_arr[:, None, None] * Bfull[None]
+             - (w_arr**2)[:, None, None] * M_j[None]).astype(complex)
+        # clamped dofs: identity rows/cols, zero rhs
+        idx = jnp.where(clamp_j, 1.0, 0.0)
+        D = D * (1 - idx[None, :, None]) * (1 - idx[None, None, :])
+        D = D + jnp.eye(3 * n_int)[None] * idx[None, :]
+        F = F * (1 - idx[None, :])
+        X = jnp.linalg.solve(D, F[..., None])[..., 0]   # (nw, 3 n_int)
+        return X
+
+    Bn = jnp.zeros((n_int, 3, 3))
+    X = solve_with_B(Bn)
+    for _ in range(n_drag_iter):
+        Xn = X.reshape(nw, n_int, 3).transpose(1, 2, 0)   # (n_int, 3, nw)
+        v_node = 1j * w_arr[None, None, :] * Xn
+        vrel = u[1:-1] - v_node
+        # RMS per node per direction split transverse/axial
+        vt = jnp.einsum("nij,njw->niw", ttn[1:-1], vrel)
+        vp = vrel - vt
+        sig_p = jnp.sqrt(0.5 * jnp.sum(jnp.abs(vp) ** 2, axis=(1, 2)))
+        sig_t = jnp.sqrt(0.5 * jnp.sum(jnp.abs(vt) ** 2, axis=(1, 2)))
+        cfac = jnp.sqrt(8.0 / jnp.pi) * drag_c
+        Bn = (cfac * Cd * sig_p)[:, None, None] * (I3[None] - ttn[1:-1]) \
+            + (cfac * CdAx * sig_t)[:, None, None] * ttn[1:-1]
+        X = solve_with_B(Bn)
+
+    # ---- dynamic tensions: axial stretch per segment
+    Xn = X.reshape(nw, n_int, 3).transpose(1, 2, 0)       # (n_int, 3, nw)
+    X_all = jnp.concatenate([XA[None], Xn, XB[None]], axis=0)  # (n+1,3,nw)
+    dX = X_all[1:] - X_all[:-1]
+    T_amp_seg = (EA / l0) * jnp.einsum("si,siw->sw", jnp.asarray(t_seg), dX)
+    T_amp = jnp.concatenate([
+        T_amp_seg[:1], 0.5 * (T_amp_seg[1:] + T_amp_seg[:-1]), T_amp_seg[-1:]
+    ], axis=0)  # (n+1, nw)
+
+    # ---- condensed fairlead impedance Z(w): force at end B per unit
+    # end-B motion with the interior dynamically condensed out
+    Bfull = jnp.zeros((3 * n_int, 3 * n_int))
+    for i in range(n_int):
+        Bfull = Bfull.at[3 * i:3 * i + 3, 3 * i:3 * i + 3].set(Bn[i])
+    D = (K_j[None] + 1j * w_arr[:, None, None] * Bfull[None]
+         - (w_arr**2)[:, None, None] * M_j[None]).astype(complex)
+    idx = jnp.where(clamp_j, 1.0, 0.0)
+    D = D * (1 - idx[None, :, None]) * (1 - idx[None, None, :])
+    D = D + jnp.eye(3 * n_int)[None] * idx[None, :]
+    K_B_m = K_B_j * (1 - idx[:, None])
+    # K_bb at the fairlead: last segment stiffness (+ half-node mass)
+    K_bb = jnp.asarray(k_seg[-1])
+    M_bb = jnp.asarray(M_node[-1]) * 0.5
+    Dinv_KB = jnp.linalg.solve(D, jnp.broadcast_to(K_B_m, (nw,) + K_B_m.shape))
+    Z_fair = (K_bb[None] - (w_arr**2)[:, None, None] * M_bb[None]
+              - jnp.einsum("ij,wjk->wik", K_B_m.T, Dinv_KB))
+    return dict(T_amp=T_amp, Z_fair=Z_fair, X=Xn)
+
+
+def fowt_line_tension_amps(ms, r6, Xi_PRP, w_arr, k_arr, S, beta, depth,
+                           rho=1025.0, g=9.81, n_seg=24):
+    """Dynamic end-tension amplitudes for every line of a FOWT's
+    quasi-static MooringSystem under platform motion Xi (moorMod 1
+    tension post-processing; raft_fowt.py:2373-2387).
+
+    Xi_PRP : (6, nw) platform motion amplitudes for one excitation
+    source.  Returns (2*nL, nw): [end A..., end B...] amplitudes.
+    """
+    from raft_tpu.ops.transforms import rotation_matrix
+
+    w_np = np.asarray(w_arr)
+    nw = len(w_np)
+    nL = ms.n_lines
+    dw = w_np[1] - w_np[0]
+    zeta = np.sqrt(2 * np.asarray(S) * dw).astype(complex)
+    out = np.zeros((2 * nL, nw), dtype=complex)
+
+    R = np.asarray(rotation_matrix(r6[3], r6[4], r6[5]))
+    Xi_j = jnp.asarray(Xi_PRP)
+    for il in range(nL):
+        r_fair = np.asarray(r6[:3]) + R @ np.asarray(ms.r_fair0[il])
+        # fairlead motion amplitudes from the platform RAO
+        lever = jnp.asarray(r_fair - np.asarray(r6[:3]))
+        dr, _, _ = wv.get_kinematics(lever, Xi_j, jnp.asarray(w_np))
+        r_nodes, T_nodes, grounded = line_static_shape(
+            ms.r_anchor[il], r_fair, float(ms.L[il]), float(ms.w[il]),
+            float(ms.EA[il]), n_seg=n_seg)
+        res = line_dynamics(
+            r_nodes, T_nodes, grounded, float(ms.L[il]), float(ms.EA[il]),
+            float(ms.m_lin[il]), float(ms.d_vol[il]),
+            w_np, np.asarray(k_arr), zeta, float(beta), depth, rho=rho, g=g,
+            Cd=float(ms.Cd[il]), Ca=float(ms.Ca[il]),
+            CdAx=float(ms.CdAx[il]), CaAx=float(ms.CaAx[il]),
+            RAO_A=None, RAO_B=np.asarray(dr))
+        out[il] = np.asarray(res["T_amp"][0])
+        out[il + nL] = np.asarray(res["T_amp"][-1])
+    return out
+
+
+def fowt_mooring_impedance(ms, r6, w_arr, k_arr, S, beta, depth,
+                           rho=1025.0, g=9.81, n_seg=24):
+    """Frequency-dependent 6x6 mooring impedance about the platform
+    reference (moorMod 2: replaces the quasi-static C_moor in the
+    platform impedance; raft_model.py:1020-1031).
+
+    Returns Z_moor (nw, 6, 6) complex."""
+    from raft_tpu.ops.transforms import rotation_matrix, skew
+
+    w_np = np.asarray(w_arr)
+    nw = len(w_np)
+    dw = w_np[1] - w_np[0]
+    zeta = np.sqrt(2 * np.asarray(S) * dw).astype(complex)
+    R = np.asarray(rotation_matrix(r6[3], r6[4], r6[5]))
+    Z = jnp.zeros((nw, 6, 6), dtype=complex)
+    for il in range(ms.n_lines):
+        r_fair = np.asarray(r6[:3]) + R @ np.asarray(ms.r_fair0[il])
+        r_nodes, T_nodes, grounded = line_static_shape(
+            ms.r_anchor[il], r_fair, float(ms.L[il]), float(ms.w[il]),
+            float(ms.EA[il]), n_seg=n_seg)
+        res = line_dynamics(
+            r_nodes, T_nodes, grounded, float(ms.L[il]), float(ms.EA[il]),
+            float(ms.m_lin[il]), float(ms.d_vol[il]),
+            w_np, np.asarray(k_arr), zeta, float(beta), depth, rho=rho, g=g,
+            Cd=float(ms.Cd[il]), Ca=float(ms.Ca[il]),
+            CdAx=float(ms.CdAx[il]), CaAx=float(ms.CaAx[il]))
+        Zf = res["Z_fair"]                       # (nw, 3, 3)
+        lever = jnp.asarray(r_fair - np.asarray(r6[:3]))
+        H = skew(lever)                          # Hv = cross(v, lever)
+        # 6x6 from a 3x3 at the fairlead: translate like a mass matrix
+        Ht = H.T
+        Z = Z.at[:, :3, :3].add(Zf)
+        Z = Z.at[:, :3, 3:].add(Zf @ H)
+        Z = Z.at[:, 3:, :3].add(jnp.einsum("ij,wjk->wik", Ht, Zf))
+        Z = Z.at[:, 3:, 3:].add(jnp.einsum("ij,wjk,kl->wil", Ht, Zf, H))
+    return Z

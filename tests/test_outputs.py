@@ -1,0 +1,97 @@
+"""Output-channel coverage: rotor response channels (speed / torque /
+power / blade pitch via the control transfer functions), the
+calcOutputs properties/eigen dicts, and the viz3Danim modes JSON.
+
+Reference surface: saveTurbineOutputs rotor block
+(raft_fowt.py:2609-2688), calcOutputs (raft_model.py:1319-1360),
+write_modes_json (raft_fowt.py:2889-3070).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import raft_tpu
+
+pytestmark = pytest.mark.slow
+
+VOLTURN = "/root/reference/designs/VolturnUS-S.yaml"
+
+
+@pytest.fixture(scope="module")
+def volturn_case_metrics():
+    from raft_tpu.structure.schema import load_design
+
+    design = load_design(VOLTURN)
+    design["settings"]["min_freq"] = 0.005
+    design["settings"]["max_freq"] = 0.2
+    # single operating wind case
+    design["cases"]["data"] = [
+        [12.0, 0, 0.1, "operating", 0, "JONSWAP", 10.0, 4.0, 0],   # above rated
+        [8.0, 0, 0.1, "operating", 0, "JONSWAP", 9.0, 3.0, 0]]     # below rated
+    model = raft_tpu.Model(design)
+    results = model.analyze_cases()
+    return model, results
+
+
+def test_rotor_channels(volturn_case_metrics):
+    model, results = volturn_case_metrics
+    m = results["case_metrics"][0][0]
+    # rotor speed: mean at the scheduled operating point, nonzero std
+    assert m["omega_avg"][0] == pytest.approx(7.56, rel=0.05)
+    assert m["omega_std"][0] > 0
+    assert m["omega_max"][0] == m["omega_avg"][0] + 2 * m["omega_std"][0]
+    assert m["omega_PSD"].shape == (model.nw, 1)
+    # torque / power positive means
+    assert m["torque_avg"][0] > 0
+    assert m["power_avg"][0] > 1e6  # 15 MW machine at 12 m/s: multi-MW
+    # above rated: pitch control active (nonzero pitch variation), torque
+    # gains zeroed by the gain-scheduling switch (raft_rotor.py:910-911)
+    assert m["bPitch_avg"][0] > 0
+    assert m["bPitch_std"][0] > 0
+    assert m["torque_std"][0] == 0
+    assert m["bPitch_PSD"].shape == (model.nw, 1)
+    assert "wind_PSD" in m
+
+    # below rated: torque control active, pitch at fine pitch
+    m2 = results["case_metrics"][1][0]
+    assert m2["torque_std"][0] > 0
+    assert m2["bPitch_std"][0] == 0
+    assert m2["omega_avg"][0] < m["omega_avg"][0]
+
+
+def test_calc_outputs_properties(volturn_case_metrics):
+    model, _ = volturn_case_metrics
+    results = model.calc_outputs()
+    p = results["properties"]
+    stat = model.statics(0)
+    assert p["total mass"] == pytest.approx(float(np.asarray(stat["M_struc"])[0, 0]))
+    assert p["buoyancy (pgV)"] == pytest.approx(
+        1025.0 * model.fowtList[0].g * float(stat["V"]), rel=1e-6)
+    assert p["substructure mass"] > 1e7  # VolturnUS-S steel semi ~ 1.7e7 kg
+    assert p["C system"].shape == (6, 6)
+    assert p["C system"][2, 2] > 0  # positive heave stiffness
+    assert p["F_lines0"].shape == (6,)
+    assert p["F_lines0"][2] < 0  # mooring pulls down
+    assert p["roll inertia at subCG"] > 0
+    # eigen block present with 6 positive rigid-body frequencies
+    fns = results["eigen"]["frequencies"]
+    assert len(fns) == 6 and np.all(fns > 0)
+
+
+def test_modes_json(volturn_case_metrics, tmp_path=None):
+    import tempfile
+
+    model, _ = volturn_case_metrics
+    path = os.path.join(tempfile.mkdtemp(), "modes.json")
+    model.write_modes_json(path)
+    doc = json.load(open(path))
+    assert doc["fileKind"] == "Modes"
+    assert len(doc["Modes"]) == model.fowtList[0].nDOF
+    assert len(doc["Connectivity"]) == len(doc["ElemProps"])
+    n_nodes = len(doc["Nodes"])
+    for mode in doc["Modes"]:
+        assert len(mode["Displ"]) == n_nodes
+        assert mode["frequency"] > 0
