@@ -37,18 +37,23 @@ def impedance(w, M, B, C):
 
 def solve_dynamics_fowt(
     fs, ss, hc, u0, M_lin, B_lin, C_lin, F_lin, w, Tn, r_nodes,
-    n_iter=15, Xi_start=0.1, tol=0.01,
+    n_iter=15, Xi_start=0.1, tol=0.01, Z_extra=None,
 ):
     """Iterative linearised solve for one FOWT's impedance and response.
 
     M_lin/B_lin : (nDOF, nDOF, nw); C_lin : (nDOF, nDOF);
     F_lin : (nDOF, nw) complex (primary-heading excitation);
     u0 : (S, 3, nw) wave velocities at strips for the primary heading.
+    Z_extra : optional (nw, nDOF, nDOF) complex impedance added to Z
+    (e.g. the frequency-dependent lumped-mass mooring impedance of
+    moorMod 2, replacing the constant C_moor in C_lin).
 
     Returns (Z (nw,nDOF,nDOF), Xi (nDOF,nw), Bmat (S,3,3)).
     """
     nDOF, nw = F_lin.shape
     S = ss.S
+    if Z_extra is None:
+        Z_extra = jnp.zeros((nw, nDOF, nDOF), dtype=complex)
 
     def linearize(XiLast):
         out = morison.hydro_linearization(fs, ss, hc, u0, XiLast, w, Tn, r_nodes)
@@ -57,7 +62,7 @@ def solve_dynamics_fowt(
     def body(carry):
         XiLast, _, _, _, it, _ = carry
         B_drag, Bmat, F_drag = linearize(XiLast)
-        Z = impedance(w, M_lin, B_lin + B_drag[:, :, None], C_lin)
+        Z = impedance(w, M_lin, B_lin + B_drag[:, :, None], C_lin) + Z_extra
         F = F_lin + F_drag
         Xi = jnp.linalg.solve(Z, jnp.moveaxis(F, -1, 0)[..., None])[..., 0]
         Xi = jnp.moveaxis(Xi, 0, -1)  # (nDOF, nw)

@@ -405,10 +405,25 @@ class Model:
                 jnp.asarray(tc["B_aero"]) + B_BEM
                 + jnp.asarray(tc["B_gyro"])[:, :, None]
             )
+            # mooring reaction: quasi-static stiffness (moorMod 0/1) or
+            # the frequency-dependent lumped-mass impedance (moorMod 2,
+            # raft_model.py:1020-1031)
             C_moor = jnp.zeros((nDOF, nDOF))
-            if self.ms_list[i] is not None:
-                C_moor = C_moor.at[:6, :6].add(
-                    mooring_stiffness(self.ms_list[i], X0[offs[i]:offs[i] + 6]))
+            Z_moor = None
+            ms_i = self.ms_list[i]
+            if ms_i is not None:
+                if getattr(ms_i, "moorMod", 0) == 2 and getattr(ms_i, "m_lin", None) is not None:
+                    from raft_tpu.physics.mooring_dynamics import fowt_mooring_impedance
+
+                    Z6 = fowt_mooring_impedance(
+                        ms_i, np.asarray(X0[offs[i]:offs[i] + 6]),
+                        self.w, self.k, fh.S[0], fh.beta[0], self.depth,
+                        rho=fs.rho_water, g=fs.g)
+                    Z_moor = jnp.zeros((nw, nDOF, nDOF), dtype=complex)
+                    Z_moor = Z_moor.at[:, :6, :6].set(Z6)
+                else:
+                    C_moor = C_moor.at[:6, :6].add(
+                        mooring_stiffness(ms_i, X0[offs[i]:offs[i] + 6]))
             C_lin = stat["C_struc"] + stat["C_hydro"] + C_moor + stat["C_elast"]
             F_lin = F_BEM[0] + exc["F_hydro_iner"][0]
 
@@ -428,7 +443,7 @@ class Model:
             Z_i, Xi_i, Bmat = solve_dynamics_fowt(
                 fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
                 jnp.asarray(self.w), fh.Tn, fh.r_nodes,
-                n_iter=self.nIter, Xi_start=self.XiStart,
+                n_iter=self.nIter, Xi_start=self.XiStart, Z_extra=Z_moor,
             )
 
             # internally-computed slender-body QTFs (potSecOrder == 1):

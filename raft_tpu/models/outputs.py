@@ -145,9 +145,36 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
     _chan(results, "pitch", RAD2DEG * Xi0_PRP[4], RAD2DEG * Xi_PRP[:, 4, :], dw)
     _chan(results, "yaw", RAD2DEG * Xi0_PRP[5], RAD2DEG * Xi_PRP[:, 5, :], dw)
 
-    # ----- mooring tensions (moorMod 0; raft_fowt.py:2356-2399)
+    # ----- mooring tensions (raft_fowt.py:2356-2399): quasi-static
+    # tension Jacobian for moorMod 0, lumped-mass line dynamics for
+    # moorMod 1/2
     ms = model.ms_list[ifowt]
-    if ms is not None:
+    if ms is not None and getattr(ms, "moorMod", 0) >= 1 \
+            and getattr(ms, "m_lin", None) is not None:
+        from raft_tpu.physics.mooring_dynamics import fowt_line_tension_amps
+
+        T_mean = mooring_tension_vector(ms, X0[:6])
+        nL = ms.n_lines
+        nWp1 = Xi.shape[0]
+        T_amps = np.zeros((nWp1, 2 * nL, model.nw), dtype=complex)
+        beta = np.atleast_1d(np.deg2rad(np.asarray(
+            case.get("wave_heading", 0.0), dtype=float)))
+        S_arr = np.atleast_2d(np.asarray(S))
+        for ih in range(nWp1 - 1):   # wave sources only (reference parity)
+            T_amps[ih] = fowt_line_tension_amps(
+                ms, np.asarray(X0[:6]), np.asarray(Xi[ih, :6, :]),
+                model.w, model.k, S_arr[min(ih, len(S_arr) - 1)],
+                float(beta[min(ih, len(beta) - 1)]), model.depth,
+                rho=fs.rho_water, g=fs.g)
+        T_std = np.sqrt(0.5 * np.sum(np.abs(T_amps) ** 2, axis=(0, 2)))
+        results["Tmoor_avg"] = T_mean
+        results["Tmoor_std"] = jnp.asarray(T_std)
+        results["Tmoor_max"] = T_mean + 3 * T_std
+        results["Tmoor_min"] = T_mean - 3 * T_std
+        dwf = float(model.w[1] - model.w[0])
+        results["Tmoor_PSD"] = jnp.asarray(
+            np.sum(0.5 * np.abs(T_amps) ** 2 / dwf, axis=0))
+    elif ms is not None:
         T_mean = mooring_tension_vector(ms, X0[:6])
         # Tension Jacobian by CENTRAL DIFFERENCES with dx = 0.1: this is
         # what MoorPy's getCoupledStiffness(tensions=True) does, and the

@@ -50,12 +50,20 @@ def line_static_shape(r_anchor, r_fair, L, w_lin, EA, n_seg=24,
     ZF = float(dv[2])
     uh = dv[:2] / max(XF, 1e-9)
 
-    HF, VF = solve_catenary(XF, ZF, L, w_lin, EA, can_ground=can_ground)
+    HF, VF, _, _ = solve_catenary(XF, ZF, L, w_lin, EA, can_ground=can_ground)
     HF, VF = float(HF), float(VF)
 
-    s = np.linspace(0.0, L, n_seg + 1)
     VA = VF - w_lin * L
-    LB = max(L - VF / w_lin, 0.0) if can_ground else 0.0
+    LB = max(L - VF / w_lin, 0.0) if (can_ground and VF < w_lin * L) else 0.0
+    if LB > 0:
+        # non-uniform nodes: touchdown is a node; most resolution goes
+        # to the suspended span (the grounded part is straight)
+        n_g = max(2, n_seg // 6)
+        n_s = n_seg - n_g
+        s = np.concatenate([np.linspace(0.0, LB, n_g + 1)[:-1],
+                            np.linspace(LB, L, n_s + 1)])
+    else:
+        s = np.linspace(0.0, L, n_seg + 1)
     grounded = s <= LB + 1e-9
 
     x = np.zeros_like(s)
@@ -88,17 +96,18 @@ def line_static_shape(r_anchor, r_fair, L, w_lin, EA, n_seg=24,
     r_nodes[:, 0] = r_anchor[0] + x * uh[0]
     r_nodes[:, 1] = r_anchor[1] + x * uh[1]
     r_nodes[:, 2] = r_anchor[2] + z
-    return r_nodes, T, grounded
+    return r_nodes, T, grounded, s
 
 
 def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
                   w_arr, k_arr, zeta, beta, depth, rho=1025.0, g=9.81,
                   Cd=1.2, Ca=1.0, CdAx=0.05, CaAx=0.0,
-                  RAO_A=None, RAO_B=None, n_drag_iter=5):
+                  RAO_A=None, RAO_B=None, n_drag_iter=5, s_arc=None):
     """Frequency-domain lumped-mass solve for one line.
 
-    r_nodes/T_nodes/grounded : static discretisation from
-    :func:`line_static_shape` (n+1 nodes).
+    r_nodes/T_nodes/grounded/s_arc : static discretisation from
+    :func:`line_static_shape` (n+1 nodes; s_arc = unstretched arc
+    coordinates, uniform L/n when omitted).
     zeta : (nw,) complex wave component amplitudes; beta heading [rad].
     RAO_A/RAO_B : (3, nw) complex end-motion amplitudes (None = fixed).
 
@@ -112,7 +121,14 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
     n_int = n - 1                 # interior nodes
     nw = len(w_arr)
     w_arr = jnp.asarray(w_arr)
-    l0 = L / n
+    if s_arc is None:
+        l0 = np.full(n, L / n)
+    else:
+        l0 = np.diff(np.asarray(s_arc, dtype=float))
+    l0 = np.maximum(l0, 1e-9)
+    ds_node = np.zeros(n + 1)
+    ds_node[:-1] += 0.5 * l0
+    ds_node[1:] += 0.5 * l0
 
     seg_vec = r_nodes[1:] - r_nodes[:-1]
     l_seg = np.linalg.norm(seg_vec, axis=1)
@@ -124,7 +140,8 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
     # ---- per-segment 3x3 stiffness: axial EA + geometric tension
     tt = np.einsum("si,sj->sij", t_seg, t_seg)
     I3 = np.eye(3)
-    k_seg = (EA / l0) * tt + (T_seg / np.maximum(l_seg, 1e-9))[:, None, None] * (I3 - tt)
+    k_seg = ((EA / l0)[:, None, None] * tt
+             + (T_seg / np.maximum(l_seg, 1e-9))[:, None, None] * (I3 - tt))
 
     # ---- assemble interior stiffness and end-coupling blocks
     K = np.zeros((3 * n_int, 3 * n_int))
@@ -152,8 +169,9 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
     t_node[1:-1] = t_seg[:-1] + t_seg[1:]
     t_node /= np.maximum(np.linalg.norm(t_node, axis=1), 1e-9)[:, None]
     ttn = np.einsum("ni,nj->nij", t_node, t_node)
-    M_node = (m_lin * l0 * I3[None]
-              + rho * A_c * l0 * (Ca * (I3[None] - ttn) + CaAx * ttn))
+    M_node = (m_lin * ds_node[:, None, None] * I3[None]
+              + rho * A_c * ds_node[:, None, None]
+              * (Ca * (I3[None] - ttn) + CaAx * ttn))
 
     M = np.zeros((3 * n_int, 3 * n_int))
     for i in range(n_int):
@@ -183,18 +201,24 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
     clamp_j = jnp.asarray(clamp)
 
     # Morison inertial excitation on interior nodes
-    F_in = (rho * A_c * l0) * (
+    F_in = (rho * A_c * jnp.asarray(ds_node[1:-1])[:, None, None]) * (
         (1.0 + Ca) * (ud[1:-1] - jnp.einsum("nij,njw->niw", ttn[1:-1], ud[1:-1]))
         + (1.0 + CaAx) * jnp.einsum("nij,njw->niw", ttn[1:-1], ud[1:-1])
     )  # (n_int, 3, nw)
 
-    drag_c = 0.5 * rho * d_vol * l0
+    drag_c = 0.5 * rho * d_vol * jnp.asarray(ds_node[1:-1])
+
+    # scatter indices for block-diagonal placement of (n_int, 3, 3)
+    _bi = 3 * np.arange(n_int)[:, None, None]
+    _rows = jnp.asarray(_bi + np.arange(3)[None, :, None] + np.zeros((1, 1, 3), int))
+    _cols = jnp.asarray(_bi + np.zeros((1, 3, 1), int) + np.arange(3)[None, None, :])
+
+    def block_diag(Bn):
+        return jnp.zeros((3 * n_int, 3 * n_int)).at[_rows, _cols].set(Bn)
 
     def solve_with_B(Bn):
         """Assemble+solve given per-node 3x3 drag matrices (n_int,3,3)."""
-        Bfull = jnp.zeros((3 * n_int, 3 * n_int))
-        for i in range(n_int):
-            Bfull = Bfull.at[3 * i:3 * i + 3, 3 * i:3 * i + 3].set(Bn[i])
+        Bfull = block_diag(Bn)
         F_drag = jnp.einsum("nij,njw->niw", Bn, u[1:-1])
         F = (F_in + F_drag).transpose(2, 0, 1).reshape(nw, 3 * n_int)
         F = F - jnp.einsum("ij,jw->wi", K_A_j, XA) - jnp.einsum("ij,jw->wi", K_B_j, XB)
@@ -228,16 +252,15 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
     Xn = X.reshape(nw, n_int, 3).transpose(1, 2, 0)       # (n_int, 3, nw)
     X_all = jnp.concatenate([XA[None], Xn, XB[None]], axis=0)  # (n+1,3,nw)
     dX = X_all[1:] - X_all[:-1]
-    T_amp_seg = (EA / l0) * jnp.einsum("si,siw->sw", jnp.asarray(t_seg), dX)
+    T_amp_seg = jnp.asarray(EA / l0)[:, None] * jnp.einsum(
+        "si,siw->sw", jnp.asarray(t_seg), dX)
     T_amp = jnp.concatenate([
         T_amp_seg[:1], 0.5 * (T_amp_seg[1:] + T_amp_seg[:-1]), T_amp_seg[-1:]
     ], axis=0)  # (n+1, nw)
 
     # ---- condensed fairlead impedance Z(w): force at end B per unit
     # end-B motion with the interior dynamically condensed out
-    Bfull = jnp.zeros((3 * n_int, 3 * n_int))
-    for i in range(n_int):
-        Bfull = Bfull.at[3 * i:3 * i + 3, 3 * i:3 * i + 3].set(Bn[i])
+    Bfull = block_diag(Bn)
     D = (K_j[None] + 1j * w_arr[:, None, None] * Bfull[None]
          - (w_arr**2)[:, None, None] * M_j[None]).astype(complex)
     idx = jnp.where(clamp_j, 1.0, 0.0)
@@ -278,7 +301,7 @@ def fowt_line_tension_amps(ms, r6, Xi_PRP, w_arr, k_arr, S, beta, depth,
         # fairlead motion amplitudes from the platform RAO
         lever = jnp.asarray(r_fair - np.asarray(r6[:3]))
         dr, _, _ = wv.get_kinematics(lever, Xi_j, jnp.asarray(w_np))
-        r_nodes, T_nodes, grounded = line_static_shape(
+        r_nodes, T_nodes, grounded, s_arc = line_static_shape(
             ms.r_anchor[il], r_fair, float(ms.L[il]), float(ms.w[il]),
             float(ms.EA[il]), n_seg=n_seg)
         res = line_dynamics(
@@ -287,7 +310,7 @@ def fowt_line_tension_amps(ms, r6, Xi_PRP, w_arr, k_arr, S, beta, depth,
             w_np, np.asarray(k_arr), zeta, float(beta), depth, rho=rho, g=g,
             Cd=float(ms.Cd[il]), Ca=float(ms.Ca[il]),
             CdAx=float(ms.CdAx[il]), CaAx=float(ms.CaAx[il]),
-            RAO_A=None, RAO_B=np.asarray(dr))
+            RAO_A=None, RAO_B=np.asarray(dr), s_arc=s_arc)
         out[il] = np.asarray(res["T_amp"][0])
         out[il + nL] = np.asarray(res["T_amp"][-1])
     return out
@@ -310,7 +333,7 @@ def fowt_mooring_impedance(ms, r6, w_arr, k_arr, S, beta, depth,
     Z = jnp.zeros((nw, 6, 6), dtype=complex)
     for il in range(ms.n_lines):
         r_fair = np.asarray(r6[:3]) + R @ np.asarray(ms.r_fair0[il])
-        r_nodes, T_nodes, grounded = line_static_shape(
+        r_nodes, T_nodes, grounded, s_arc = line_static_shape(
             ms.r_anchor[il], r_fair, float(ms.L[il]), float(ms.w[il]),
             float(ms.EA[il]), n_seg=n_seg)
         res = line_dynamics(
@@ -318,7 +341,7 @@ def fowt_mooring_impedance(ms, r6, w_arr, k_arr, S, beta, depth,
             float(ms.m_lin[il]), float(ms.d_vol[il]),
             w_np, np.asarray(k_arr), zeta, float(beta), depth, rho=rho, g=g,
             Cd=float(ms.Cd[il]), Ca=float(ms.Ca[il]),
-            CdAx=float(ms.CdAx[il]), CaAx=float(ms.CaAx[il]))
+            CdAx=float(ms.CdAx[il]), CaAx=float(ms.CaAx[il]), s_arc=s_arc)
         Zf = res["Z_fair"]                       # (nw, 3, 3)
         lever = jnp.asarray(r_fair - np.asarray(r6[:3]))
         H = skew(lever)                          # Hv = cross(v, lever)

@@ -1,0 +1,166 @@
+"""Lumped-mass mooring dynamics (moorMod 1/2) tests.
+
+MoorPy (the reference's backend for ``line.dynamicSolve`` /
+``getCoupledDynamicMatrices``) is not in this image, so validation is
+physics-based:
+
+* the quasi-static limit: at vanishing frequency the dynamic tension
+  and the condensed fairlead impedance must reduce to the catenary's
+  static tension Jacobian / stiffness;
+* inertia: at high frequency the dynamic tension exceeds quasi-static
+  (added-mass + drag reaction of the line), the hallmark the lumped-
+  mass model exists to capture;
+* end-to-end: a VolturnUS-S-style model runs moorMod 1 and 2 and the
+  dynamic tension statistics differ from the quasi-static ones.
+"""
+
+import numpy as np
+import pytest
+
+import raft_tpu
+from raft_tpu.physics.mooring import (MooringSystem, mooring_force,
+                                      solve_catenary)
+from raft_tpu.physics.mooring_dynamics import (fowt_mooring_impedance,
+                                               line_dynamics,
+                                               line_static_shape)
+
+pytestmark = pytest.mark.slow
+
+# one VolturnUS-S-style chain line
+ANCHOR = np.array([-837.6, 0.0, -200.0])
+FAIR = np.array([-58.0, 0.0, -14.0])
+L, W_LIN, EA = 850.0, (685.0 - 1025 * np.pi / 4 * 0.333**2) * 9.81, 3.27e9
+M_LIN, D_VOL = 685.0, 0.333
+
+
+def quasi_static_jacobian(dr=0.05):
+    """dT_fair/dr_fair and dF/dr_fair by central differences."""
+    def tens(rf):
+        dv = rf - ANCHOR
+        XF, ZF = np.hypot(dv[0], dv[1]), dv[2]
+        HF, VF, _, _ = solve_catenary(XF, ZF, L, W_LIN, EA)
+        return float(np.hypot(HF, VF))
+
+    J = np.zeros(3)
+    for j in range(3):
+        e = np.zeros(3)
+        e[j] = dr
+        J[j] = (tens(FAIR + e) - tens(FAIR - e)) / (2 * dr)
+    return J
+
+
+def run_line(w_arr, rao_dir, amp=1.0, with_waves=False):
+    r_nodes, T_nodes, grounded, s_arc = line_static_shape(ANCHOR, FAIR, L, W_LIN, EA)
+    nw = len(w_arr)
+    k_arr = np.asarray(w_arr) ** 2 / 9.81
+    zeta = (np.full(nw, 1.0 + 0j) if with_waves else np.zeros(nw, complex))
+    RAO_B = np.zeros((3, nw), complex)
+    RAO_B[rao_dir] = amp
+    return line_dynamics(r_nodes, T_nodes, grounded, L, EA, M_LIN, D_VOL,
+                         np.asarray(w_arr), k_arr, zeta, 0.0, 200.0,
+                         RAO_B=RAO_B, s_arc=s_arc)
+
+
+def test_quasi_static_tension_limit():
+    """w -> 0: fairlead dynamic tension == static tension Jacobian."""
+    J = quasi_static_jacobian()
+    w_arr = np.array([0.02, 0.05])
+    for j, direction in enumerate(["surge", "heave"]):
+        res = run_line(w_arr, rao_dir=0 if direction == "surge" else 2)
+        T_dyn = float(np.abs(np.asarray(res["T_amp"])[-1, 0]))
+        T_qs = abs(J[0 if direction == "surge" else 2])
+        assert T_dyn == pytest.approx(T_qs, rel=0.05), direction
+
+
+def test_quasi_static_impedance_limit():
+    """w -> 0: Re(Z_fair) == static line stiffness at the fairlead."""
+    def force(rf):
+        dv = rf - ANCHOR
+        XF, ZF = np.hypot(dv[0], dv[1]), dv[2]
+        HF, VF, _, _ = solve_catenary(XF, ZF, L, W_LIN, EA)
+        uh = dv[:2] / max(XF, 1e-9)
+        return np.array([-HF * uh[0], -HF * uh[1], -VF])
+
+    K_qs = np.zeros((3, 3))
+    for j in range(3):
+        e = np.zeros(3)
+        e[j] = 0.05
+        K_qs[:, j] = -(force(FAIR + e) - force(FAIR - e)) / 0.1
+
+    res = run_line(np.array([0.02]), rao_dir=0)
+    Z0 = np.asarray(res["Z_fair"])[0].real
+    # compare the dominant surge-surge and heave-heave terms
+    assert Z0[0, 0] == pytest.approx(K_qs[0, 0], rel=0.08)
+    assert Z0[2, 2] == pytest.approx(K_qs[2, 2], rel=0.08)
+
+
+def test_dynamic_amplification():
+    """High-frequency axial tension exceeds quasi-static (line inertia
+    and drag resist the fairlead motion)."""
+    J = quasi_static_jacobian()
+    res = run_line(np.array([0.05, 1.5, 2.5]), rao_dir=0)
+    T = np.abs(np.asarray(res["T_amp"])[-1])
+    assert T[0] == pytest.approx(abs(J[0]), rel=0.06)
+    assert T[2] > 1.5 * T[0]  # strong dynamic amplification at 2.5 rad/s
+
+
+def test_moormod_impedance_6dof():
+    ms = MooringSystem(
+        r_anchor=ANCHOR[None, :], r_fair0=FAIR[None, :],
+        L=np.array([L]), w=np.array([W_LIN]), EA=np.array([EA]), depth=200.0,
+        m_lin=np.array([M_LIN]), d_vol=np.array([D_VOL]),
+        Cd=np.array([1.2]), Ca=np.array([1.0]),
+        CdAx=np.array([0.05]), CaAx=np.array([0.0]), moorMod=2)
+    w_arr = np.arange(0.05, 1.55, 0.25)
+    S = np.ones(len(w_arr)) * 1.0
+    Z = np.asarray(fowt_mooring_impedance(
+        ms, np.zeros(6), w_arr, w_arr**2 / 9.81, S, 0.0, 200.0))
+    assert Z.shape == (len(w_arr), 6, 6)
+    # low-frequency real part ~ quasi-static coupled stiffness
+    from raft_tpu.physics.mooring import mooring_stiffness
+    import jax.numpy as jnp
+
+    C_qs = np.asarray(mooring_stiffness(ms, jnp.zeros(6)))
+    assert Z[0, 0, 0].real == pytest.approx(C_qs[0, 0], rel=0.1)
+    assert Z[0, 2, 2].real == pytest.approx(C_qs[2, 2], rel=0.1)
+    # damping (positive imaginary part) appears at wave frequencies
+    assert Z[4, 0, 0].imag > 0
+
+
+def test_model_moormod_end_to_end():
+    """VolturnUS-S with moorMod 1 (dynamic tensions) and 2 (dynamic
+    impedance): both run end to end; tension std differs from
+    quasi-static; moorMod 2 shifts the surge response."""
+    from raft_tpu.structure.schema import load_design
+
+    base = load_design("/root/reference/designs/VolturnUS-S.yaml")
+    base["settings"]["min_freq"] = 0.005
+    base["settings"]["max_freq"] = 0.12
+    base["cases"]["data"] = [
+        [0.0, 0, 0, "operating", 0, "JONSWAP", 10.0, 5.0, 0]]
+
+    stds = {}
+    surge_std = {}
+    for mod in (0, 1, 2):
+        import copy
+
+        design = copy.deepcopy(base)
+        design["mooring"]["moorMod"] = mod
+        model = raft_tpu.Model(design)
+        results = model.analyze_cases()
+        m = results["case_metrics"][0][0]
+        stds[mod] = np.asarray(m["Tmoor_std"])
+        surge_std[mod] = float(np.asarray(m["surge_std"]))
+        assert np.all(np.isfinite(stds[mod]))
+
+    # dynamic tensions differ from (and are generally larger than)
+    # quasi-static at the fairlead ends
+    nL = 3
+    fair = slice(nL, 2 * nL)
+    assert not np.allclose(stds[1][fair], stds[0][fair], rtol=0.02)
+    assert np.all(stds[1][fair] > 0)
+    # moorMod 2 changes the platform response (mooring inertia/damping)
+    assert surge_std[2] != pytest.approx(surge_std[0], rel=1e-3)
+    # moorMod 1 and 2 tension magnitudes are in the same ballpark
+    assert np.all(stds[2][fair] < 10 * stds[1][fair] + 1e3)
+    assert np.all(stds[1][fair] < 5 * stds[0][fair] + 1e3)
