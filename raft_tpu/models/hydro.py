@@ -89,7 +89,10 @@ class FOWTHydro:
             r_nodes, R_ptfm, r_root = platform_kinematics(fs, Xi0)
             Tn = node_T(r_nodes, r_root)
             return r_nodes, R_ptfm, r_root, Tn
-        disp = (np.asarray(fs.T) @ np.asarray(Xi0)).reshape(fs.n_nodes, 6)
+        # nonlinear rigid-link/beam mean-offset kinematics
+        # (setNodesPosition, raft_fowt.py:669-752)
+        disp = fs.topology.displacements(
+            fs.T, fs.reducedDOF, fs.root_id, np.asarray(Xi0))
         r_np = fs.node_r0 + disp[:, :3]
         # T depends on the current node positions through the rigid-link
         # offsets (reference recomputes reduceDOF after setPosition,

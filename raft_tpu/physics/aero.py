@@ -153,6 +153,19 @@ def build_rotor_aero(turbine, ir=0, submerged=False):
         PchipInterpolator(r_thick_unique, st_cd[idx])(np.flip(rthick)), axis=0
     )
 
+    # CCBlade's CCAirfoil evaluates the polars with a CUBIC spline in
+    # angle of attack; approximate that in-trace by resampling the
+    # station polars through a cubic spline onto a 6x-dense grid and
+    # interpolating linearly there (sub-0.1% of the spline everywhere)
+    from scipy.interpolate import CubicSpline
+
+    aoa_dense = np.unique(np.concatenate([
+        np.linspace(-180, -30, 6 * int(n_aoa / 4) + 1),
+        np.linspace(-30, 30, 6 * int(n_aoa / 2)),
+        np.linspace(30, 180, 6 * int(n_aoa / 4) + 1)]))
+    cl_dense = np.stack([CubicSpline(aoa, c)(aoa_dense) for c in cl_interp])
+    cd_dense = np.stack([CubicSpline(aoa, c)(aoa_dense) for c in cd_interp])
+
     geom = np.array(blade["geometry"])
     dr = (Rtip - Rhub) / nr
     blade_r = np.linspace(Rhub, Rtip, nr, endpoint=False) + dr / 2
@@ -190,7 +203,7 @@ def build_rotor_aero(turbine, ir=0, submerged=False):
         precurve=precurve, presweep=presweep,
         precurveTip=float(blade.get("precurveTip", 0.0)),
         presweepTip=float(blade.get("presweepTip", 0.0)),
-        aoa_deg=aoa, cl=cl_interp, cd=cd_interp,
+        aoa_deg=aoa_dense, cl=cl_dense, cd=cd_dense,
         U_sched=U, Omega_sched=Om, pitch_sched=pit,
     )
 
@@ -352,11 +365,10 @@ def rotor_loads(rot: RotorAeroModel, Uinf, Omega_rpm, pitch_deg, tilt, yaw):
     """
     x_az, y_az, z_az, cone, s = _curvature(rot.r, rot.precurve, rot.presweep, rot.precone)
     x_az, y_az, z_az, cone = map(jnp.asarray, (x_az, y_az, z_az, cone))
-    # full grid (hub/tip endpoints) for load integration
-    rfull = np.r_[rot.Rhub, rot.r, rot.Rtip]
-    cvfull = np.r_[0.0, rot.precurve, rot.precurveTip]
-    swfull = np.r_[0.0, rot.presweep, rot.presweepTip]
-    xf, yf, zf, conef, sf = _curvature(rfull, cvfull, swfull, rot.precone)
+    # CCBlade integrates the distributed loads over the element stations
+    # themselves (np.trapz over r/s with NO zero end-padding); matching
+    # that scheme is required for golden-level load parity at nr=20
+    xf, yf, zf, conef, sf = x_az, y_az, z_az, cone, s
 
     Omega = Omega_rpm * jnp.pi / 30.0
     theta_rad = jnp.deg2rad(rot.theta_deg + pitch_deg)
@@ -387,9 +399,8 @@ def rotor_loads(rot: RotorAeroModel, Uinf, Omega_rpm, pitch_deg, tilt, yaw):
             jnp.asarray(lc_hub), jnp.asarray(rot.cl), jnp.asarray(rot.cd),
             jnp.asarray(rot.chord),
         )
-        # pad with zero loads at hub/tip and integrate over arc length
-        Npf = jnp.concatenate([jnp.zeros(1), Np, jnp.zeros(1)])
-        Tpf = jnp.concatenate([jnp.zeros(1), Tp, jnp.zeros(1)])
+        Npf = Np
+        Tpf = Tp
         ccf = jnp.cos(jnp.asarray(conef))
         scf = jnp.sin(jnp.asarray(conef))
         sfj = jnp.asarray(sf)

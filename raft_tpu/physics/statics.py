@@ -248,14 +248,12 @@ def calc_statics(fs, Xi0=None):
     rho, g = fs.rho_water, fs.g
     nDOF = fs.nDOF
     if not fs.is_single_body:
-        # mixed rigid/flexible structures use the general numpy path at
-        # the reference pose (see physics/statics_general.py)
-        if Xi0 is not None and np.any(np.asarray(Xi0) != 0):
-            raise NotImplementedError(
-                "general statics currently evaluates at the reference pose")
+        # mixed rigid/flexible structures use the general numpy path,
+        # with nonlinear rigid-link/beam kinematics at displaced poses
+        # (see physics/statics_general.py)
         from raft_tpu.physics.statics_general import calc_statics_general
 
-        return calc_statics_general(fs)
+        return calc_statics_general(fs, Xi0=Xi0)
     if Xi0 is None:
         Xi0 = jnp.zeros(nDOF)
 

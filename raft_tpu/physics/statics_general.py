@@ -233,10 +233,29 @@ def _beam_hydrostatics(mem, node_r, rho, g):
                 AWP=AWP, IWP=IWP, xWP=xWP, yWP=yWP)
 
 
-def calc_statics_general(fs):
+def _rotated_member(mem, th):
+    """Shallow member copy with axes rotated by the (finite) node
+    rotation vector th — member.setPosition tracking nodeList[0].r[3:]
+    (raft_member.py:348-357)."""
+    import dataclasses
+
+    if th is None or not np.any(th):
+        return mem
+    from raft_tpu.ops import transforms as tf
+
+    R = np.asarray(tf.rotation_matrix(th[0], th[1], th[2]))
+    return dataclasses.replace(
+        mem, q0=R @ mem.q0, p10=R @ mem.p10, p20=R @ mem.p20,
+        R0=R @ mem.R0)
+
+
+def calc_statics_general(fs, Xi0=None):
     """FOWT.calcStatics equivalent for mixed rigid/flexible structures
-    at the reference pose (raft_fowt.py:811-1285).  Returns the same
-    dict as the jax fast path (numpy values)."""
+    (raft_fowt.py:811-1285), optionally at a DISPLACED mean pose: node
+    positions from the nonlinear rigid-link/beam kinematics
+    (setNodesPosition, raft_fowt.py:669-752), member axes rotated with
+    their nodes, and T rebuilt at the displaced positions.  Returns the
+    same dict as the jax fast path (numpy values)."""
     import jax.numpy as jnp
 
     from raft_tpu.physics.statics import member_hydrostatics, member_inertia
@@ -247,6 +266,16 @@ def calc_statics_general(fs):
     T = fs.T
     dT = fs.dT
     node_r = fs.node_r0
+    node_rot = None
+    if Xi0 is not None and np.any(np.asarray(Xi0)):
+        disp = fs.topology.displacements(
+            fs.T, fs.reducedDOF, fs.root_id, np.asarray(Xi0, dtype=float))
+        node_r = fs.node_r0 + disp[:, :3]
+        node_rot = disp[:, 3:]
+        # T depends on the current node positions through the rigid-link
+        # offsets (reference recomputes reduceDOF after setPosition)
+        T, _, _ = fs.topology.reduce(positions=node_r)
+        fs.topology.reduce()  # restore reference-pose traversal state
 
     M_full = np.zeros((nF, nF))
     Msub_full = np.zeros((nF, nF))
@@ -273,6 +302,8 @@ def calc_statics_general(fs):
 
     for im, mem in enumerate(fs.members):
         n0 = int(fs.member_node[im])
+        if node_rot is not None:
+            mem = _rotated_member(mem, node_rot[n0])
         if mem.mtype == "rigid":
             nn = 1
             r_n = node_r[n0]
@@ -381,9 +412,12 @@ def calc_statics_general(fs):
 
     for ir, rot in enumerate(fs.rotors):
         node = int(fs.rotor_node[ir])
+        Rn = np.eye(3)
+        if node_rot is not None and np.any(node_rot[node]):
+            Rn = np.asarray(tf.rotation_matrix(*node_rot[node]))
         Mm = np.diag([rot.mRNA, rot.mRNA, rot.mRNA, rot.IxRNA, rot.IrRNA, rot.IrRNA])
-        Mm = np.asarray(tf.rotate_matrix_6(jnp2.asarray(Mm), jnp2.asarray(rot.R_q0)))
-        dCG = rot.q_rel * rot.xCG_RNA
+        Mm = np.asarray(tf.rotate_matrix_6(jnp2.asarray(Mm), jnp2.asarray(Rn @ rot.R_q0)))
+        dCG = (Rn @ rot.q_rel) * rot.xCG_RNA
         W6, C6 = _weight_point(rot.mRNA, dCG, g)
         sl = slice(6 * node, 6 * node + 6)
         W_full[sl.start:sl.stop] += W6

@@ -228,6 +228,84 @@ class Topology:
                 T[6 * n.id : 6 * n.id + 6, reducedDOF.index(d)] = n.T_aux[:, jcol]
         return T, reducedDOF, root.id
 
+    def displacements(self, T, reducedDOF, root_id, Xi0):
+        """Nonlinear mean node displacements (n_nodes, 6) for reduced
+        displacements Xi0 — the setNodesPosition nonlinear path
+        (raft_fowt.py:669-752): rigid links rotate exactly
+        ((R(theta) - I) d), ball/universal joints keep their own linear
+        rotation, beam chains get linear displacements plus the
+        end-node's nonlinear-minus-linear correction.  Preserves rigid
+        link lengths at large mean rotations (the displaced-pose statics
+        of flexible/multibody structures need this)."""
+        Xi0 = np.asarray(Xi0, dtype=float)
+        nodes = self.nodes
+        n = len(nodes)
+        lin = (np.asarray(T) @ Xi0).reshape(n, 6)
+        disp = np.full((n, 6), np.nan)
+
+        def rotmat(th):
+            from raft_tpu.ops import transforms as tf
+            import jax.numpy as jnp
+
+            return np.asarray(tf.rotation_matrix(th[0], th[1], th[2]))
+
+        links_by_node: dict[int, list[int]] = {}
+        for a, b in self._links:
+            links_by_node.setdefault(a, []).append(b)
+            links_by_node.setdefault(b, []).append(a)
+        joint_groups: dict[int, list[int]] = {}
+        for nd in nodes:
+            if nd.joint_id is not None:
+                joint_groups.setdefault(nd.joint_id, []).append(nd.id)
+        chains_by_node: dict[int, list[int]] = {}
+        for chain in self._chains:
+            for nid in chain:
+                chains_by_node[nid] = chain
+
+        root = nodes[root_id]
+        disp[root.id] = lin[root.id]
+        visited = {root.id}
+        queue = [root]
+        while queue:
+            node = queue.pop(0)
+            # rigid-link partners: exact rotation of the offset
+            for pid in links_by_node.get(node.id, []):
+                p = nodes[pid]
+                if p.id in visited:
+                    continue
+                d = p.r0 - node.r0
+                R = rotmat(lin[node.id][3:])
+                disp[p.id] = disp[node.id].copy()
+                disp[p.id][:3] += (R - np.eye(3)) @ d
+                visited.add(p.id)
+                queue.append(p)
+            # joint-connected nodes: same translation; ball/universal
+            # joints keep their own (linear) rotation
+            if node.joint_id is not None:
+                for nid in joint_groups.get(node.joint_id, []):
+                    nn = nodes[nid]
+                    if nn.id in visited:
+                        continue
+                    disp[nn.id] = disp[node.id].copy()
+                    if nn.joint_type in ("ball", "universal"):
+                        disp[nn.id][3:] = lin[nn.id][3:]
+                    visited.add(nn.id)
+                    queue.append(nn)
+            # beam chains: linear + the end node's nonlinear correction
+            if node.end_node and node.id in chains_by_node:
+                dR = disp[node.id] - lin[node.id]
+                for nid in chains_by_node[node.id]:
+                    if nid in visited:
+                        continue
+                    disp[nid] = lin[nid] + dR
+                    visited.add(nid)
+                    queue.append(nodes[nid])
+        # any unreached node (shouldn't happen on a connected structure)
+        # falls back to the linear map
+        missing = np.isnan(disp[:, 0])
+        disp[missing] = lin[missing]
+        return disp
+
     def reduce_with_derivative(self):
         """T at the reference pose plus dT/d(reduced rotation dofs).
 
