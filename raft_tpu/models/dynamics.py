@@ -59,27 +59,52 @@ def solve_dynamics_fowt(
         out = morison.hydro_linearization(fs, ss, hc, u0, XiLast, w, Tn, r_nodes)
         return out["B_hydro_drag"], out["Bmat"], out["F_hydro_drag"]
 
-    def body(carry):
-        XiLast, _, _, _, it, _ = carry
+    def update(XiLast):
+        """One full (un-relaxed) linearise-and-solve step."""
         B_drag, Bmat, F_drag = linearize(XiLast)
         Z = impedance(w, M_lin, B_lin + B_drag[:, :, None], C_lin) + Z_extra
         F = F_lin + F_drag
         Xi = jnp.linalg.solve(Z, jnp.moveaxis(F, -1, 0)[..., None])[..., 0]
-        Xi = jnp.moveaxis(Xi, 0, -1)  # (nDOF, nw)
+        return jnp.moveaxis(Xi, 0, -1), Z, Bmat  # (nDOF, nw)
+
+    def body(carry):
+        XiLast, it, _ = carry
+        Xi, _, _ = update(XiLast)
         tolCheck = jnp.abs(Xi - XiLast) / (jnp.abs(Xi) + tol)
         done = jnp.all(tolCheck < tol)
         XiNext = jnp.where(done, XiLast, 0.2 * XiLast + 0.8 * Xi)
-        return XiNext, Xi, Z, Bmat, it + 1, done
+        return XiNext, it + 1, done
 
     def cond(carry):
-        *_, it, done = carry
+        _, it, done = carry
         return (it < n_iter + 1) & (~done)
 
+    def run_fixed_point(f, Xinit):
+        XiLast, _, _ = jax.lax.while_loop(cond, body, (Xinit, 0, jnp.asarray(False)))
+        return XiLast
+
+    def residual(X):
+        Xi, _, _ = update(X)
+        return X - Xi
+
+    def tangent_solve(g, y):
+        # g(x) = x - A x with A the (contractive) linearised drag
+        # coupling — solve by Neumann iteration x <- y + (x - g(x)),
+        # which converges at the same rate as the fixed point itself
+        x = y
+        for _ in range(10):
+            x = y + (x - g(x))
+        return x
+
+    # implicit differentiation of the drag-linearisation fixed point
+    # (lax.custom_root): forward value identical to the reference-style
+    # under-relaxed iteration; jax.grad works through the converged
+    # point instead of unrolling the while_loop (SURVEY.md §7.1)
     Xi0 = jnp.full((nDOF, nw), Xi_start, dtype=complex)
-    Z0 = jnp.zeros((nw, nDOF, nDOF), dtype=complex)
-    Bmat0 = jnp.zeros((S, 3, 3))
-    carry = (Xi0, Xi0, Z0, Bmat0, 0, jnp.asarray(False))
-    XiLast, Xi, Z, Bmat, _, _ = jax.lax.while_loop(cond, body, carry)
+    XiLast = jax.lax.custom_root(residual, Xi0, run_fixed_point, tangent_solve)
+    # final response/impedance at the converged linearisation (exactly
+    # the quantities the while_loop's last iteration produced)
+    Xi, Z, Bmat = update(XiLast)
     return Z, Xi, Bmat
 
 

@@ -116,7 +116,22 @@ def solve_equilibrium_general(
         _, it, done = carry
         return (it < max_iter) & (~done)
 
-    X, _, _ = jax.lax.while_loop(cond, body, (X0, 0, jnp.asarray(False)))
+    def run_newton(f, Xinit):
+        X, _, _ = jax.lax.while_loop(cond, body, (Xinit, 0, jnp.asarray(False)))
+        return X
+
+    def tangent_solve(g, y):
+        # g is the linearized residual (the equilibrium Jacobian); the
+        # system is small (nDOF), so materialise and solve directly
+        J = jax.jacfwd(g)(jnp.zeros_like(y))
+        return jnp.linalg.solve(J, y)
+
+    # implicit differentiation of the converged equilibrium
+    # (lax.custom_root): forward value identical to the plain Newton
+    # while_loop; gradients flow through the implicit function theorem,
+    # enabling jax.grad (reverse mode) of response metrics wrt design
+    # parameters (SURVEY.md §7.1)
+    X = jax.lax.custom_root(net_force, X0, run_newton, tangent_solve)
     return X, net_force(X)
 
 

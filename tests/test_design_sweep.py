@@ -56,3 +56,26 @@ def test_design_gradient(evaluator):
     eps = 1e-4
     fd = (float(metric(1.0 + eps)) - float(metric(1.0 - eps))) / (2 * eps)
     assert abs(float(g) - fd) / (abs(fd) + 1e-9) < 5e-2
+
+
+def test_reverse_mode_gradient(evaluator):
+    """jax.grad (reverse mode) through the full evaluation: the statics
+    Newton and drag-linearisation fixed points are wrapped in
+    lax.custom_root (implicit differentiation), so gradients of response
+    metrics wrt design parameters work in BOTH modes and agree with
+    finite differences (the gradient-based L6 design-optimization
+    story, SURVEY.md §7.1)."""
+    import jax
+
+    evaluate = evaluator
+
+    def metric(Ls):
+        out = evaluate(dict(Hs=6.0, Tp=12.0, beta=0.3, L_moor_scale=Ls))
+        return jnp.sum(jnp.abs(out["Xi"][0]) ** 2) + jnp.sum(out["X0"] ** 2)
+
+    g_rev = float(jax.grad(metric)(1.0))
+    g_fwd = float(jax.jacfwd(metric)(1.0))
+    assert g_rev == pytest.approx(g_fwd, rel=1e-10)
+    eps = 1e-4
+    fd = float((metric(1.0 + eps) - metric(1.0 - eps)) / (2 * eps))
+    assert g_rev == pytest.approx(fd, rel=2e-3)
