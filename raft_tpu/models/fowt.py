@@ -58,6 +58,9 @@ class RotorProps:
     I_drivetrain: float = 0.0
     aeroServoMod: int = 1
     yaw_mode: int = 0
+    # submerged (MHK) rotor blade hydro summary about the rotor node:
+    # dict(A_hydro, I_hydro, Fvec, Cmat, V) or None (raft_rotor.py:604-656)
+    hydro: dict | None = None
 
 
 class FOWTStructure:
@@ -169,6 +172,19 @@ class FOWTStructure:
             r_rel = r_rel.copy()
             r_rel[2] = hHub - q_rel[2] * overhang
         R_q0 = _rotmat_np(0.0, -shaft_tilt, shaft_toe)  # yaw = 0 at build
+        Zhub = r_rel[2] + q_rel[2] * overhang
+        rotor_hydro = None
+        if Zhub < 0 and "blade" in turbine and "airfoils" in turbine:
+            from raft_tpu.physics.aero import blade_hydro
+
+            props = RotorProps(
+                mRNA=0, IxRNA=0, IrRNA=0, xCG_RNA=0, overhang=overhang,
+                shaft_tilt=shaft_tilt, shaft_toe=shaft_toe, precone=precone,
+                nBlades=int(coerce(turbine, "nBlades", shape=nrotors,
+                                   dtype=int, default=3)[ir]),
+                r_rel=r_rel, q_rel=q_rel, R_q0=R_q0, Zhub=Zhub)
+            rotor_hydro = blade_hydro(
+                turbine, ir, props, rho_water=self.rho_water, g=self.g)
         return RotorProps(
             mRNA=coerce(turbine, "mRNA", shape=nrotors)[ir],
             IxRNA=coerce(turbine, "IxRNA", shape=nrotors)[ir],
@@ -186,6 +202,7 @@ class FOWTStructure:
             I_drivetrain=float(coerce(turbine, "I_drivetrain", shape=nrotors, default=0.0)[ir]),
             aeroServoMod=int(coerce(turbine, "aeroServoMod", shape=nrotors, dtype=int, default=1)[ir]),
             yaw_mode=int(coerce(turbine, "yaw_mode", shape=nrotors, dtype=int, default=0)[ir]),
+            hydro=rotor_hydro,
         )
 
     # ------------------------------------------------------------------

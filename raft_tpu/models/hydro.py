@@ -74,6 +74,14 @@ class FOWTHydro:
             self.hc0 = to_host(
                 morison.hydro_constants(fs, self.strips, R0, r0_nodes, Tn0)
             )
+            # submerged (MHK) rotor added mass via blade members
+            # (raft_fowt.py:1618-1625)
+            for ir, rot in enumerate(fs.rotors):
+                if rot.hydro is not None:
+                    node = int(fs.rotor_node[ir])
+                    Tn_n = np.asarray(Tn0[node])  # (6, nDOF)
+                    self.hc0["A_hydro"] = np.asarray(self.hc0["A_hydro"]) + (
+                        Tn_n.T @ np.asarray(rot.hydro["A_hydro"]) @ Tn_n)
             self.set_position(np.zeros(fs.nDOF))
 
     def _kinematics(self, Xi0):
@@ -132,6 +140,30 @@ class FOWTHydro:
             jnp.asarray(self.w), jnp.asarray(self.k), self.Tn, self.r_nodes,
         )
         self.u = out["u"]
+
+        # submerged rotor inertial excitation from hub wave kinematics
+        # (raft_fowt.py:1861-1883)
+        fs = self.fs
+        for ir, rot in enumerate(fs.rotors):
+            if rot.hydro is None:
+                continue
+            node = int(fs.rotor_node[ir])
+            r_hub = self.r_nodes[node] + jnp.asarray(rot.q_rel) * rot.overhang
+            F_add = []
+            I6 = jnp.asarray(rot.hydro["I_hydro"])
+            for ih in range(len(beta)):
+                _, ud, _ = wv.wave_kinematics(
+                    jnp.asarray(zeta[ih], dtype=complex)[None, :],
+                    float(beta[ih]), jnp.asarray(self.w), jnp.asarray(self.k),
+                    fs.depth, r_hub, rho=fs.rho_water, g=fs.g)
+                ud = ud.reshape(3, -1)  # (3, nw)
+                # I_hydro is assembled ABOUT THE ROTOR NODE (blade_hydro
+                # includes the element moment arms), so no extra lever here
+                f3 = jnp.einsum("ij,jw->iw", I6[:3, :3], ud)
+                m3 = jnp.einsum("ij,jw->iw", I6[3:, :3], ud)
+                F_add.append(jnp.einsum(
+                    "ia,iw->aw", self.Tn[node], jnp.concatenate([f3, m3])))
+            out["F_hydro_iner"] = out["F_hydro_iner"] + jnp.stack(F_add)
         return out
 
     def hydro_linearization(self, Xi, ih=0):

@@ -349,6 +349,11 @@ class Model:
             out["rotor_info"][ir] = dict(
                 info, speed=speed, aeroServoMod=rprops.aeroServoMod,
                 Ng=rot.Ng)
+            if current and rot.cpmin is not None:
+                from raft_tpu.physics.aero import calc_cavitation
+
+                out["rotor_info"][ir]["cavitation"] = calc_cavitation(
+                    rot, rprops, case, rho=fs.rho_water, g=fs.g)
             # gyroscopic damping (raft_fowt.py:1569-1581)
             Om_rpm = float(operating_point(rot, speed)[0])
             IO = info["q"] * (rprops.I_drivetrain * Om_rpm * 2 * np.pi / 60)

@@ -332,6 +332,10 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
     RADPS2RPM = 60.0 / (2 * np.pi)
     for ir in range(nrot):
         ri = rotor_info[ir] if rotor_info else None
+        if ri is not None and ri.get("cavitation") is not None:
+            # per-(blade, element) cavitation margins; negative =
+            # cavitation occurs (raft_fowt.py:2680-2683)
+            results["cavitation"] = np.asarray(ri["cavitation"])
         if ri is None or ri.get("aeroServoMod", 0) <= 1 or ri.get("speed", 0) <= 0:
             continue
         node = int(fs.rotor_node[ir])

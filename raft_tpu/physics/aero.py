@@ -74,6 +74,8 @@ class RotorAeroModel:
     Omega_sched: np.ndarray # [rpm]
     pitch_sched: np.ndarray # [deg]
 
+    cpmin: np.ndarray | None = None  # (nr, n_aoa) min pressure coefficient
+
     # control gains (aeroServoMod == 2)
     kp_0: np.ndarray | None = None
     ki_0: np.ndarray | None = None
@@ -119,10 +121,14 @@ def build_rotor_aero(turbine, ir=0, submerged=False):
     thick = np.array([a["relative_thickness"] for a in airfoils])
     cl = np.zeros((n_af, len(aoa)))
     cd = np.zeros((n_af, len(aoa)))
+    has_cpmin = all(len(np.array(a["data"])[0]) > 4 for a in airfoils)
+    cpm = np.zeros((n_af, len(aoa))) if has_cpmin else None
     for i, a in enumerate(airfoils):
         tab = np.array(a["data"])
         cl[i] = np.interp(aoa, tab[:, 0], tab[:, 1])
         cd[i] = np.interp(aoa, tab[:, 0], tab[:, 2])
+        if has_cpmin:
+            cpm[i] = np.interp(aoa, tab[:, 0], tab[:, 4])
         # enforce +/-180 deg continuity (raft_rotor.py:243-251)
         cl[i, 0] = cl[i, -1]
         cd[i, 0] = cd[i, -1]
@@ -133,11 +139,14 @@ def build_rotor_aero(turbine, ir=0, submerged=False):
     st_thick = np.zeros(nSt)
     st_cl = np.zeros((nSt, len(aoa)))
     st_cd = np.zeros((nSt, len(aoa)))
+    st_cpm = np.zeros((nSt, len(aoa))) if has_cpmin else None
     for i in range(nSt):
         j = names.index(station_airfoil[i])
         st_thick[i] = thick[j]
         st_cl[i] = cl[j]
         st_cd[i] = cd[j]
+        if has_cpmin:
+            st_cpm[i] = cpm[j]
 
     nSector = int(coerce(blade, "nSector", default=4))
     nr = int(coerce(blade, "nr", default=20))
@@ -152,6 +161,11 @@ def build_rotor_aero(turbine, ir=0, submerged=False):
     cd_interp = np.flip(
         PchipInterpolator(r_thick_unique, st_cd[idx])(np.flip(rthick)), axis=0
     )
+    cpm_interp = None
+    if has_cpmin:
+        cpm_interp = np.flip(
+            PchipInterpolator(r_thick_unique, st_cpm[idx])(np.flip(rthick)),
+            axis=0)
 
     # CCBlade's CCAirfoil evaluates the polars with a CUBIC spline in
     # angle of attack; approximate that in-trace by resampling the
@@ -165,6 +179,9 @@ def build_rotor_aero(turbine, ir=0, submerged=False):
         np.linspace(30, 180, 6 * int(n_aoa / 4) + 1)]))
     cl_dense = np.stack([CubicSpline(aoa, c)(aoa_dense) for c in cl_interp])
     cd_dense = np.stack([CubicSpline(aoa, c)(aoa_dense) for c in cd_interp])
+    cpm_dense = None
+    if has_cpmin:
+        cpm_dense = np.stack([CubicSpline(aoa, c)(aoa_dense) for c in cpm_interp])
 
     geom = np.array(blade["geometry"])
     dr = (Rtip - Rhub) / nr
@@ -203,7 +220,7 @@ def build_rotor_aero(turbine, ir=0, submerged=False):
         precurve=precurve, presweep=presweep,
         precurveTip=float(blade.get("precurveTip", 0.0)),
         presweepTip=float(blade.get("presweepTip", 0.0)),
-        aoa_deg=aoa_dense, cl=cl_dense, cd=cd_dense,
+        aoa_deg=aoa_dense, cl=cl_dense, cd=cd_dense, cpmin=cpm_dense,
         U_sched=U, Omega_sched=Om, pitch_sched=pit,
     )
 
@@ -583,6 +600,150 @@ def calc_aero(rot: RotorAeroModel, rprops, case, w, speed=None,
     return f0, f, a, b, dict(loads=loads, dT=dT, dQ=dQ, Omega_rpm=float(Om),
                              pitch_deg=float(pit), V_w=V_w, R_q=R_q, q=q,
                              **chan)
+
+
+# -------------------------------------------- MHK: blade hydro + cavitation
+
+def blade_hydro(turbine, ir, rprops, rho_water=1025.0, g=9.81, n_azimuth=None):
+    """Build-time hydrodynamic summary of a SUBMERGED rotor's blades
+    about the rotor node: added mass A (6,6), inertial-excitation
+    I (6,6), buoyancy force/stiffness (Fvec (6,), Cmat (6,6)) and
+    displaced volume.
+
+    Equivalent of Rotor.calcHydroConstants + the blade-member
+    buoyancy loop (raft_rotor.py:604-656, raft_fowt.py:937-1005):
+    blade elements are rectangular members (chord x relative-thickness
+    x chord cross-section) with airfoil added-mass coefficients,
+    summed over the B blade azimuths.
+    """
+    from scipy.interpolate import PchipInterpolator
+
+    blade = turbine["blade"]
+    blade = blade[ir] if isinstance(blade, list) else blade
+    nrotors = turbine.get("nrotors", 1)
+    B = int(coerce(turbine, "nBlades", shape=nrotors, dtype=int)[ir])
+    Rhub = float(coerce(turbine, "Rhub", shape=nrotors)[ir])
+    Rtip = float(blade["Rtip"])
+    nr = int(coerce(blade, "nr", default=20))
+    dr = (Rtip - Rhub) / nr
+    r_e = np.linspace(Rhub, Rtip, nr, endpoint=False) + dr / 2
+
+    geom = np.array(blade["geometry"])
+    chord = np.interp(r_e, geom[:, 0], geom[:, 1])
+    twist = np.deg2rad(np.interp(r_e, geom[:, 0], geom[:, 2]))
+
+    # station relative thickness + added-mass coefficients
+    airfoils = turbine["airfoils"]
+    names = [a["name"] for a in airfoils]
+    thick = np.array([a["relative_thickness"] for a in airfoils])
+    Ca_af = np.array([a.get("added_mass_coeff", [0.5, 1.0]) for a in airfoils])
+    st_pos = np.array([a for [a, b] in blade["airfoils"]])
+    st_thick = np.array([thick[names.index(b)] for [a, b] in blade["airfoils"]])
+    st_Ca = np.array([Ca_af[names.index(b)] for [a, b] in blade["airfoils"]])
+    grid = (r_e - Rhub) / (Rtip - Rhub)
+    t_rel = PchipInterpolator(st_pos, st_thick)(grid)
+    Ca_e = PchipInterpolator(st_pos, st_Ca)(grid)  # (nr, 2) [edge, flap]
+
+    V_e = chord * (t_rel * chord) * dr  # rectangular cross-section volume
+
+    azimuths = np.deg2rad(np.asarray(coerce(
+        turbine, "azimuths", shape=-1,
+        default=list(np.arange(B) * 360.0 / B)), dtype=float))
+
+    R_q0 = np.asarray(rprops.R_q0)
+    q_hub = R_q0 @ np.array([1.0, 0.0, 0.0])       # shaft axis (global)
+    r_hub = np.asarray(rprops.q_rel) * rprops.overhang  # hub wrt rotor node
+
+    A6 = np.zeros((6, 6))
+    I6 = np.zeros((6, 6))
+    Fvec = np.zeros(6)
+    Cmat = np.zeros((6, 6))
+    V_tot = 0.0
+    from raft_tpu.ops import transforms as tf
+    import jax.numpy as jnp
+
+    for psi in azimuths:
+        cpsi, spsi = np.cos(psi), np.sin(psi)
+        for ie in range(nr):
+            # span direction: 'up' blade rotated by psi about the shaft,
+            # in the hub frame then to global
+            u_loc = np.array([0.0, -spsi, cpsi])
+            u = R_q0 @ u_loc
+            e_t = np.cross(q_hub, u)
+            e_t /= max(np.linalg.norm(e_t), 1e-12)
+            th = twist[ie]
+            p1 = e_t * np.cos(th) + q_hub * np.sin(th)   # chordwise
+            p2 = np.cross(u, p1)                          # thickness dir
+            r_el = r_hub + u * r_e[ie]
+
+            zg = rprops.r_rel[2] + r_el[2]
+            if zg >= 0:
+                continue  # only submerged elements contribute
+            A3 = rho_water * V_e[ie] * (
+                Ca_e[ie, 0] * np.outer(p1, p1) + Ca_e[ie, 1] * np.outer(p2, p2))
+            I3 = rho_water * V_e[ie] * (
+                (1 + Ca_e[ie, 0]) * np.outer(p1, p1)
+                + (1 + Ca_e[ie, 1]) * np.outer(p2, p2))
+            A6 += np.asarray(tf.translate_matrix_3to6(
+                jnp.asarray(A3), jnp.asarray(r_el)))
+            H = np.asarray(tf.skew(jnp.asarray(r_el)))
+            I6[:3, :3] += I3
+            I6[3:, :3] += H.T @ I3
+            W6, C6 = tf.weight_of_point_mass(
+                -rho_water * V_e[ie], jnp.asarray(r_el), g=g)
+            Fvec += np.asarray(W6)
+            Cmat += np.asarray(C6)
+            V_tot += V_e[ie]
+
+    return dict(A_hydro=A6, I_hydro=I6, Fvec=Fvec, Cmat=Cmat, V=V_tot,
+                r_hub=r_hub)
+
+
+def calc_cavitation(rot: RotorAeroModel, rprops, case, Patm=101325.0,
+                    Pvap=2300.0, rho=1025.0, g=9.81):
+    """Cavitation margin per (blade, element) for a submerged rotor.
+
+    Rotor.calcCavitation equivalent (raft_rotor.py:657-716):
+    sigma_crit = (Patm + rho g |z| - Pvap) / (0.5 rho W^2) compared to
+    -cpmin(alpha); negative margin = cavitation.  Requires cpmin polars
+    (5th column of the airfoil data tables).
+    """
+    if rot.cpmin is None:
+        return None
+    speed = float(coerce(case, "current_speed", shape=0, default=1.0))
+    Om, pit = operating_point(rot, speed)
+    Om, pit = float(Om), float(pit)
+    Omega = Om * np.pi / 30.0
+
+    x_az, y_az, z_az, cone, _ = _curvature(rot.r, rot.precurve, rot.presweep,
+                                           rot.precone)
+    theta_r = np.deg2rad(rot.theta_deg + pit)
+    sigma_p = rot.B * rot.chord / (2 * np.pi * rot.r)
+    lct = rot.B / 2 * (rot.Rtip - rot.r) / rot.r
+    lch = rot.B / 2 * (rot.r - rot.Rhub) / rot.Rhub
+    aoa_rad = jnp.deg2rad(jnp.asarray(rot.aoa_deg))
+
+    azimuths = np.arange(rot.nSector) * 2 * np.pi / rot.nSector
+    cav = np.zeros((len(azimuths), len(rot.r)))
+    for ia, az in enumerate(azimuths):
+        Vx, Vy = _wind_components(rot, speed, Omega, az, -rprops.shaft_tilt,
+                                  0.0, jnp.asarray(x_az), jnp.asarray(y_az),
+                                  jnp.asarray(z_az), jnp.asarray(cone))
+        for ie in range(len(rot.r)):
+            phi, a, ap = _solve_phi(Vx[ie], Vy[ie], sigma_p[ie], theta_r[ie],
+                                    lct[ie], lch[ie], jnp.asarray(rot.cl[ie]),
+                                    jnp.asarray(rot.cd[ie]), aoa_rad)
+            phi, a, ap = float(phi), float(a), float(ap)
+            W2 = (float(Vx[ie]) * (1 - a)) ** 2 + (float(Vy[ie]) * (1 + ap)) ** 2
+            alpha = np.degrees(phi) - (rot.theta_deg[ie] + pit)
+            cpmin_n = float(np.interp(alpha, rot.aoa_deg, rot.cpmin[ie]))
+            # element depth: blade 'up' at azimuth 0, rotating about the
+            # (tilted) shaft
+            zrel = z_az[ie] * np.cos(az) * np.cos(rprops.shaft_tilt)
+            depth = abs(rprops.Zhub + zrel)
+            sigma_crit = (Patm + rho * g * depth - Pvap) / (0.5 * rho * max(W2, 1e-9))
+            cav[ia, ie] = sigma_crit + cpmin_n
+    return cav
 
 
 # ------------------------------------------------- traced aero-servo path

@@ -350,6 +350,14 @@ def calc_statics(fs, Xi0=None):
         r_CG_rel = R_ptfm @ jnp.asarray(rot.r_rel) + dCG
         m_center_sum = m_center_sum + r_CG_rel * rot.mRNA
 
+        # submerged rotor blade buoyancy (raft_fowt.py:937-1005)
+        if rot.hydro is not None:
+            Wh_blocks = Wh_blocks.at[node].add(jnp.asarray(rot.hydro["Fvec"]))
+            Ch_blocks = Ch_blocks.at[node].add(jnp.asarray(rot.hydro["Cmat"]))
+            V_rot = float(rot.hydro["V"])
+            VTOT = VTOT + V_rot
+            Sum_V_rCB = Sum_V_rCB + jnp.asarray(fs.node_r0[node]) * V_rot
+
     # ---------------- point inertias (raft_fowt.py:1054-1072)
     for pi in fs.pointInertias:
         node = int(

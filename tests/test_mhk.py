@@ -45,3 +45,42 @@ def test_mhk_dynamics(model):
     case = dict(zip(model.design["cases"]["keys"], model.design["cases"]["data"][0]))
     Xi, info = model.solve_dynamics(case)
     assert np.isfinite(np.asarray(Xi)).all()
+
+
+def test_mhk_rotor_blade_hydro(model):
+    """Submerged rotor blade-member hydro (raft_rotor.py:604-656):
+    added mass, inertial excitation and buoyancy about the rotor node."""
+    fs = model.fowtList[0]
+    rh = fs.rotors[0].hydro
+    assert rh is not None
+    assert rh["V"] > 0.0                       # displaced blade volume
+    A = np.asarray(rh["A_hydro"])
+    assert np.allclose(A, A.T, atol=1e-6 * np.max(np.abs(A)))
+    assert np.all(np.linalg.eigvalsh(A[:3, :3]) >= -1e-9)
+    # buoyancy is upward
+    assert rh["Fvec"][2] > 0
+    # inertial excitation exceeds added mass (Cm = 1 + Ca)
+    I3 = np.asarray(rh["I_hydro"])[:3, :3]
+    assert np.trace(I3) > np.trace(A[:3, :3])
+
+    # the FOWT-level added-mass matrix includes the rotor contribution
+    A_tot = np.asarray(model.hydro[0].hc0["A_hydro"])
+    assert np.all(np.isfinite(A_tot))
+
+
+def test_mhk_cavitation(model):
+    """Cavitation margins computed from the BEMT relative velocities and
+    cpmin polars (raft_rotor.py:657-716); positive margin = no
+    cavitation at the RM1 design point."""
+    case = dict(zip(model.design["cases"]["keys"], model.design["cases"]["data"][0]))
+    tc = model.turbine_constants(case, 0)
+    cav = tc["rotor_info"][0].get("cavitation")
+    assert cav is not None
+    assert cav.shape[1] == len(model.rotor_aero[0].r)
+    assert np.all(np.isfinite(cav))
+    # margins positive across the blade at the design flow speed
+    assert np.all(cav > 0)
+
+    # and the channel lands in the case metrics
+    results = model.analyze_cases()
+    assert "cavitation" in results["case_metrics"][0][0]
