@@ -501,6 +501,10 @@ class Model:
 
         F_sys = jnp.stack([jnp.concatenate(Fw, axis=0) for Fw in F_waves])
         Xi = system_response(Z_sys, F_sys)
+        # numerical sanity guard (raft_model.py:1098-1099)
+        if not bool(jnp.all(jnp.isfinite(jnp.abs(Xi)))):
+            raise RuntimeError(
+                "NaN detected in response vector Xi (solveDynamics guard)")
         Xi = jnp.concatenate(
             [Xi, jnp.zeros((1, self.nDOF, nw), dtype=complex)], axis=0)
         info0 = infos[0]
@@ -511,24 +515,36 @@ class Model:
 
     @property
     def bem(self):
-        """Lazy potential-flow coefficients: WAMIT-format files when the
-        design points at them (readHydro equivalent,
+        """First FOWT's potential-flow coefficients (see bem_list)."""
+        return self.bem_list[0]
+
+    @property
+    def bem_list(self):
+        """Per-FOWT potential-flow coefficients: WAMIT-format files when
+        the design points at them (readHydro equivalent,
         raft_fowt.py:1444-1509), otherwise the NATIVE panel solver runs
         on the potMod members (calcBEM equivalent, :1288-1442)."""
-        if not hasattr(self, "_bem"):
-            self._bem = None
-            fs = self.fowtList[0]
-            if fs.potFirstOrder == 1 and fs.hydroPath:
-                from raft_tpu.io.wamit import load_bem_coefficients
+        if not hasattr(self, "_bem_list"):
+            self._bem_list = []
+            for i, fs in enumerate(self.fowtList):
+                bem_i = None
+                if fs.potFirstOrder == 1 and fs.hydroPath:
+                    from raft_tpu.io.wamit import load_bem_coefficients
 
-                path = self._resolve_data_path(fs.hydroPath, (".1", ".3"))
-                self._bem = load_bem_coefficients(
-                    path, self.w, fs.rho_water, fs.g,
-                    r_ref=fs.node_r0[fs.root_id],
-                )
-            elif any(m.potMod for m in fs.members):
-                self._bem = self.run_bem()
-        return self._bem
+                    path = self._resolve_data_path(fs.hydroPath, (".1", ".3"))
+                    bem_i = load_bem_coefficients(
+                        path, self.w, fs.rho_water, fs.g,
+                        r_ref=fs.node_r0[fs.root_id],
+                    )
+                    for key in ("A_BEM", "B_BEM", "X_BEM"):
+                        if not np.all(np.isfinite(bem_i[key])):
+                            raise RuntimeError(
+                                f"non-finite {key} coefficients loaded from "
+                                f"{path} (raft_fowt.py:1503-1509 guard)")
+                elif any(m.potMod for m in fs.members):
+                    bem_i = self.run_bem(ifowt=i)
+                self._bem_list.append(bem_i)
+        return self._bem_list
 
     def run_bem(self, ifowt=0, w_bem=None, headings=None, save_dir=None,
                 n_az=None, dz_max=None, force=False, workers=None):
@@ -590,9 +606,10 @@ class Model:
         nDOF, nw = self.fowtList[ifowt].nDOF, self.nw
         A = np.zeros((nDOF, nDOF, nw))
         B = np.zeros((nDOF, nDOF, nw))
-        if self.bem is not None:
-            A[:6, :6, :] = self.bem["A_BEM"]
-            B[:6, :6, :] = self.bem["B_BEM"]
+        bem = self.bem_list[ifowt]
+        if bem is not None:
+            A[:6, :6, :] = bem["A_BEM"]
+            B[:6, :6, :] = bem["B_BEM"]
         return jnp.asarray(A), jnp.asarray(B)
 
     def bem_excitation(self, case, fh, ifowt=0):
@@ -606,7 +623,8 @@ class Model:
         nDOF, nw = fs.nDOF, self.nw
         nWaves = 1 if np.isscalar(case.get("wave_heading", 0)) else len(case["wave_heading"])
         F = np.zeros((nWaves, nDOF, nw), dtype=complex)
-        if self.bem is not None and np.any(np.abs(self.bem["X_BEM"]) > 0):
+        bem = self.bem_list[ifowt]
+        if bem is not None and np.any(np.abs(bem["X_BEM"]) > 0):
             S, zeta, beta = make_sea_state(case, self.w)
             heading = np.atleast_1d(np.degrees(beta))
             for ih in range(nWaves):
@@ -614,7 +632,7 @@ class Model:
                     fs.x_ref * np.cos(np.radians(heading[ih]))
                     + fs.y_ref * np.sin(np.radians(heading[ih]))))
                 X = interp_heading(
-                    self.bem["X_BEM"], self.bem["headings"],
+                    bem["X_BEM"], bem["headings"],
                     (heading[ih] - fs.heading_adjust) % 360)
                 # interp_heading rotates by the BEM-frame heading; global
                 # rotation uses the absolute heading
