@@ -122,3 +122,89 @@ def adjust_ballast(base_design, target_heave=0.0, heave_tol=0.05, max_iter=12):
         if abs(h1 - target_heave) < heave_tol:
             break
     return model, s1
+
+
+def adjust_ballast_density(base_design):
+    """Uniformly shift ballast fill densities to zero the unloaded
+    heave (Model.adjustBallastDensity equivalent, raft_model.py:1772).
+
+    One closed-form step: delta_rho = sumFz / (g * V_ballast), applied
+    to every section with nonzero fill, then the model is rebuilt.
+    Returns (model, delta_rho).
+    """
+    import copy
+
+    import numpy as np
+
+    import raft_tpu
+    from raft_tpu.structure.schema import load_design
+
+    base = load_design(base_design)
+    model = raft_tpu.Model(copy.deepcopy(base))
+    fs = model.fowtList[0]
+    stat = model.statics(0)
+    g = fs.g
+    X0 = np.asarray(model.solve_statics(None))
+    from raft_tpu.physics.mooring import mooring_force
+    import jax.numpy as jnp
+
+    Fm = np.zeros(6)
+    if model.ms is not None:
+        Fm = np.asarray(mooring_force(model.ms, jnp.asarray(X0[:6]))[0])
+    sumFz = (-float(np.asarray(stat["M_struc"])[0, 0]) * g
+             + float(stat["V"]) * fs.rho_water * g + Fm[2])
+
+    V_ballast = float(sum(sum(m.vfill) for m in fs.members))
+    if V_ballast <= 0:
+        raise ValueError("adjust_ballast_density needs nonzero ballast volume")
+    delta_rho = sumFz / g / V_ballast
+
+    d = copy.deepcopy(base)
+    for mi in d["platform"]["members"]:
+        if "rho_fill" in mi and "l_fill" in mi:
+            lf = np.atleast_1d(np.asarray(mi["l_fill"], dtype=float))
+            rf = np.atleast_1d(np.asarray(mi["rho_fill"], dtype=float))
+            rf = np.where(lf > 0, rf + delta_rho, rf)
+            mi["rho_fill"] = rf.tolist() if rf.size > 1 else float(rf[0])
+    return raft_tpu.Model(d), float(delta_rho)
+
+
+def adjust_wisdem(model, old_wisdem_file, new_wisdem_file):
+    """Write RAFT-adjusted ballast fill volumes back into a WISDEM
+    geometry YAML (Model.adjustWISDEM equivalent, raft_model.py:1830):
+    WISDEM members are matched to RAFT members by bottom-joint elevation
+    and base diameter, and their first ballast volume is updated from
+    the RAFT member's fill level."""
+    import numpy as np
+    import yaml
+
+    with open(old_wisdem_file, encoding="utf-8") as f:
+        wisdem_design = yaml.safe_load(f)
+
+    fs = model.fowtList[0]
+    members_w = wisdem_design["components"]["floating_platform"]["members"]
+    joints_w = wisdem_design["components"]["floating_platform"]["joints"]
+    for wm in members_w:
+        if "ballasts" not in wm.get("internal_structure", {}):
+            continue
+        for rm in fs.members:
+            matched = False
+            for joint in joints_w:
+                if wm["joint1"] != joint["name"]:
+                    continue
+                same_z = str(joint["location"][2])[0:5] == str(rm.rA0[2])[0:5]
+                same_d = (wm["outer_shape"]["outer_diameter"]["values"][0]
+                          == rm.d[0, 0])
+                if same_z and same_d:
+                    area = np.pi * ((rm.d[0, 0] - 2 * rm.t[0]) / 2) ** 2
+                    lf = np.atleast_1d(np.asarray(rm.l_fill, dtype=float))
+                    wm["internal_structure"]["ballasts"][0]["volume"] = \
+                        float(area * lf[0])
+                    matched = True
+                break
+            if matched:
+                break
+
+    with open(new_wisdem_file, "w", encoding="utf-8") as f:
+        yaml.safe_dump(wisdem_design, f, default_flow_style=None, sort_keys=False)
+    return wisdem_design

@@ -333,11 +333,16 @@ class Model:
             current = rprops.Zhub < 0  # submerged rotor -> current-driven
             if current:
                 speed = float(coerce(case, "current_speed", shape=0, default=1.0))
+            elif isinstance(case.get("wind_speed"), (list, tuple, np.ndarray)):
+                # per-FOWT waked wind speeds from the farm wake coupling
+                # (raft_model.py:646-648)
+                speed = float(np.asarray(case["wind_speed"], dtype=float)[
+                    min(ifowt, len(case["wind_speed"]) - 1)])
             else:
                 speed = float(coerce(case, "wind_speed", shape=0, default=10))
             if rprops.aeroServoMod <= 0 or speed <= 0:
                 continue
-            f0, f, a, b, info = calc_aero(rot, rprops, case, self.w, current=current)
+            f0, f, a, b, info = calc_aero(rot, rprops, case, self.w, speed=speed, current=current)
             node = int(fs.rotor_node[ir])
             Tn = np.asarray(fh.Tn[node])  # (6, nDOF)
             out["f_aero0"][:, ir] = Tn.T @ f0
@@ -761,6 +766,16 @@ class Model:
             fns, modes = self.solve_eigen()
         write_modes_json(self, filename, np.asarray(fns), np.asarray(modes),
                          ifowt=ifowt)
+
+    def wake_coupling(self, u_grid=None):
+        """Set up farm wake coupling (florisCoupling equivalent,
+        raft_model.py:1956-2053) using the built-in Gaussian wake model
+        and this model's own BEMT power/thrust curves.  Returns the
+        WakeCoupling driver (find_equilibrium / calc_aep)."""
+        from raft_tpu.physics.wake import WakeCoupling
+
+        self.wake = WakeCoupling(self, u_grid=u_grid)
+        return self.wake
 
     # ---------------------------------------------------------- case driver
     def analyze_cases(self):

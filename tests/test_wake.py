@@ -1,0 +1,80 @@
+"""Farm wake coupling + AEP and ballast-density trimming tests.
+
+The FLORIS-coupling capability (raft_model.py:1956-2245) with the
+built-in Gaussian wake model: waked rotor speeds feed back into the
+array equilibrium (per-FOWT wind speeds), powers and platform positions
+converge, and a wind rose integrates to AEP.
+"""
+
+import numpy as np
+import pytest
+
+import raft_tpu
+from raft_tpu.physics.wake import farm_velocities, gaussian_deficit
+
+pytestmark = pytest.mark.slow
+
+FARM = "/root/reference/tests/test_data/VolturnUS-S_farm.yaml"
+
+
+def test_gaussian_deficit_physics():
+    D, Ct, TI = 240.0, 0.8, 0.06
+    # deficit decays downstream and crosswind; zero upstream
+    d5 = gaussian_deficit(5 * D, 0.0, D, Ct, TI)
+    d10 = gaussian_deficit(10 * D, 0.0, D, Ct, TI)
+    assert 0 < d10 < d5 < 1
+    assert gaussian_deficit(5 * D, 3 * D, D, Ct, TI) < 0.2 * d5
+    assert gaussian_deficit(-2 * D, 0.0, D, Ct, TI) == 0.0
+
+
+def test_farm_velocities_ordering():
+    """Downstream turbine sees a slower waked flow; crosswind neighbour
+    is nearly unaffected."""
+    xy = np.array([[0.0, 0.0], [1200.0, 0.0], [0.0, 1500.0]])
+    D = np.array([240.0] * 3)
+    ct = [lambda U: 0.8] * 3
+    U, Ct = farm_velocities(xy, D, ct, 10.0, 0.0, 0.06)
+    assert U[0] == pytest.approx(10.0)
+    assert U[1] < 9.5            # waked
+    assert U[2] == pytest.approx(10.0, abs=0.05)
+
+
+@pytest.fixture(scope="module")
+def farm_model():
+    import os
+
+    if not os.path.exists(FARM):
+        pytest.skip("reference farm design unavailable")
+    return raft_tpu.Model(FARM)
+
+
+def test_wake_equilibrium_and_aep(farm_model):
+    model = farm_model
+    wake = model.wake_coupling(u_grid=np.arange(4.0, 25.0, 1.0))
+    keys = model.design["cases"]["keys"]
+    case = dict(zip(keys, [10.0, 0.0, 0.06, "operating", 0,
+                           "JONSWAP", 8.0, 2.0, 0]))
+    winds, xs, ys, powers = wake.find_equilibrium(case, n_iter=4)
+    assert winds.shape[1] == model.nFOWT
+    # all turbines see at most the free stream; at least one is waked
+    # or all free depending on layout vs wind direction
+    assert np.all(winds[-1] <= 10.0 + 1e-6)
+    assert np.all(powers[-1] >= 0)
+    assert np.all(np.isfinite(xs)) and np.all(np.isfinite(ys))
+
+    # a 2-state wind rose integrates to a positive AEP
+    p, aep, total = wake.calc_aep([8.0, 30.0], [0.0, 90.0], [0.7, 0.3],
+                                  cutin=3.0, cutout=25.0, TI=0.06, n_iter=3)
+    assert p.shape == (2, model.nFOWT)
+    assert np.all(p[1] == 0)     # above cutout
+    assert total > 0
+
+
+def test_adjust_ballast_density():
+    from raft_tpu.drivers import adjust_ballast_density
+
+    model, d_rho = adjust_ballast_density(
+        "/root/reference/designs/VolturnUS-S.yaml")
+    X = np.asarray(model.solve_statics(None))
+    assert abs(X[2]) < 0.05      # trimmed heave
+    assert abs(d_rho) < 500.0    # sane density shift
