@@ -282,15 +282,63 @@ def mesh_rectangular(stations, sides, rA, q, p1, p2, dz_max=2.0, da_max=2.0):
             np.asarray(areas))
 
 
-def mesh_fowt(fs, dz_max=None, n_az=18, da_max=None):
+def point_in_member(pts, mem, shrink=1e-3):
+    """Boolean mask: points strictly inside a member's outer volume.
+
+    Circular members: radial distance against the station-interpolated
+    radius; rectangular: |p1|,|p2| components against half-sides.  The
+    ``shrink`` margin keeps panels ON the surface classified outside.
+    """
+    pts = np.asarray(pts, dtype=float)
+    rA = np.asarray(mem.rA0, dtype=float)
+    q = np.asarray(mem.q0, dtype=float)
+    s = (pts - rA) @ q
+    inside_ax = (s > 1e-6) & (s < mem.stations[-1] - 1e-6)
+    radial = pts - rA - s[:, None] * q[None, :]
+    if mem.circular:
+        r_at = np.interp(s, mem.stations, mem.d[:, 0] / 2.0)
+        inside_r = np.linalg.norm(radial, axis=1) < r_at * (1 - shrink) - 1e-6
+    else:
+        p1 = np.asarray(mem.p10, dtype=float)
+        p2 = np.asarray(mem.p20, dtype=float)
+        a_at = np.interp(s, mem.stations, mem.d[:, 0] / 2.0)
+        b_at = np.interp(s, mem.stations, mem.d[:, 1] / 2.0)
+        inside_r = ((np.abs(radial @ p1) < a_at * (1 - shrink) - 1e-6)
+                    & (np.abs(radial @ p2) < b_at * (1 - shrink) - 1e-6))
+    return inside_ax & inside_r
+
+
+def remove_interior_panels(verts, cents, norms, areas, members, owner):
+    """Drop panels whose centroids lie inside ANOTHER member's volume.
+
+    This is the functional effect of the reference's boolean-union
+    intersection mesher (IntersectionMesh.py:139: pygmsh OCC union +
+    clipping): interior surfaces where members overlap do not radiate
+    and pollute the source-panel solve.  ``owner`` maps each panel to
+    the member index that generated it.
+    """
+    keep = np.ones(len(areas), dtype=bool)
+    for im, mem in enumerate(members):
+        others = owner != im
+        if not np.any(others):
+            continue
+        keep[others] &= ~point_in_member(cents[others], mem)
+    return verts[keep], cents[keep], norms[keep], areas[keep]
+
+
+def mesh_fowt(fs, dz_max=None, n_az=18, da_max=None, intersect=True):
     """Combined wetted-surface panel mesh of a FOWT's potMod members at
     the reference pose (the calcBEM meshing stage,
     raft_fowt.py:1327-1344).  Members are meshed independently, as the
     reference's member2pnl does (no boolean union).
 
+    ``intersect``: drop panels lying inside other members (the
+    functional equivalent of the reference's boolean-union
+    IntersectionMesh path; raft_fowt.py:1346-1402).
+
     Returns (vertices, centroids, normals, areas)."""
-    vs, cs, ns, as_ = [], [], [], []
-    for mem in fs.members:
+    vs, cs, ns, as_, owner = [], [], [], [], []
+    for im, mem in enumerate(fs.members):
         if not mem.potMod:
             continue
         draft = -min(mem.rA0[2], mem.rB0[2])
@@ -311,11 +359,18 @@ def mesh_fowt(fs, dz_max=None, n_az=18, da_max=None):
             cs.append(c)
             ns.append(nr)
             as_.append(a)
+            owner.append(np.full(len(a), im))
     if not vs:
         return (np.zeros((0, 4, 3)), np.zeros((0, 3)), np.zeros((0, 3)),
                 np.zeros(0))
-    return (np.concatenate(vs), np.concatenate(cs), np.concatenate(ns),
-            np.concatenate(as_))
+    verts = np.concatenate(vs)
+    cents = np.concatenate(cs)
+    norms = np.concatenate(ns)
+    areas = np.concatenate(as_)
+    if intersect:
+        verts, cents, norms, areas = remove_interior_panels(
+            verts, cents, norms, areas, fs.members, np.concatenate(owner))
+    return verts, cents, norms, areas
 
 
 def read_pnl(path):

@@ -155,3 +155,24 @@ def test_oc4semi_potmod2_end_to_end(tmp_path):
         turbine_status="operating", yaw_misalign=0)
     Xi, info = model.solve_dynamics(case)
     assert np.isfinite(np.asarray(Xi)).all()
+
+
+def test_interior_panel_removal():
+    """Panels buried inside an intersecting member are removed (the
+    functional effect of the reference's boolean-union
+    IntersectionMesh); surface panels survive."""
+    import raft_tpu
+    from raft_tpu.io.panels import mesh_fowt
+    from raft_tpu.structure.schema import load_design
+
+    design = load_design("/root/reference/designs/OC4semi.yaml")
+    design["platform"]["potModMaster"] = 2
+    design["settings"]["nAz_BEM"] = 8
+    design["settings"]["dz_BEM"] = 3.0
+    model = raft_tpu.Model(design)
+    fs = model.fowtList[0]
+    v1, c1, n1, a1 = mesh_fowt(fs, dz_max=3.0, n_az=8, intersect=False)
+    v2, c2, n2, a2 = mesh_fowt(fs, dz_max=3.0, n_az=8, intersect=True)
+    # OC4's pontoons/braces run into the columns: interior panels exist
+    assert len(a2) < len(a1)
+    assert len(a2) > 0.7 * len(a1)  # but most of the surface survives
