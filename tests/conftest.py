@@ -31,6 +31,24 @@ def pytest_configure(config):
         "fast tier: pytest -m 'not slow')",
     )
 
+
+import gc
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled-executable caches after each test module.
+
+    The full suite compiles hundreds of distinct programs (every design
+    family x stage); on the CPU backend the accumulated executables can
+    push the process into XLA compiler OOM segfaults late in the run.
+    """
+    yield
+    jax.clear_caches()
+    gc.collect()
+
 REFERENCE_DIR = "/root/reference"
 REF_TEST_DATA = os.path.join(REFERENCE_DIR, "tests", "test_data")
 
