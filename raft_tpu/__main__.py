@@ -1,9 +1,23 @@
-"""CLI: ``python -m raft_tpu design.yaml [--csv out.csv]``."""
+"""CLI: ``python -m raft_tpu design.yaml [--csv out.csv]``.
+
+The orchestrated analysis path is the float64 host-side parity path
+(the TPU path is the traced evaluator used by bench/sweeps), so the
+CLI pins the CPU backend + x64 unless RAFT_TPU_CLI_PLATFORM overrides
+it — accelerator plugins without f64 support would otherwise fail.
+"""
 
 import argparse
+import os
 
 
 def main():
+    platform = os.environ.get("RAFT_TPU_CLI_PLATFORM", "cpu")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+        if platform == "cpu":
+            jax.config.update("jax_enable_x64", True)
     p = argparse.ArgumentParser(
         description="raft_tpu: TPU-native frequency-domain FOWT analysis")
     p.add_argument("design", help="design YAML (RAFT-compatible schema)")

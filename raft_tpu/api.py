@@ -107,7 +107,7 @@ def make_design_evaluator(model):
         C_lin = jnp.asarray(K_h) + C_moor
         F_lin = exc["F_hydro_iner"][0]
 
-        Z, _, Bmat = solve_dynamics_fowt(
+        Z, _, Bmat, dyn_diag = solve_dynamics_fowt(
             fs, ss, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
             w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart)
         F_wave = exc["F_hydro_iner"][0] + morison.drag_excitation(
@@ -116,6 +116,8 @@ def make_design_evaluator(model):
         return dict(
             X0=X0, Xi=Xi, RAO=wv.get_rao(Xi, zeta),
             PSD=0.5 * jnp.abs(Xi) ** 2 / dw, S=S,
+            drag_resid=dyn_diag["drag_resid"],
+            drag_converged=dyn_diag["drag_converged"],
         )
 
     return evaluate
@@ -201,7 +203,7 @@ def _hydro_force_2nd_traced(Qm, heads_rad, beta, S0, dw):
     return f_mean, f_out.T
 
 
-def make_full_evaluator(model, nWaves=1, turb_static=None):
+def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
     """Build the FULL-PHYSICS traced case evaluator for a single-FOWT
     model: aero-servo constants + gyroscopics, potential-flow A/B/X,
     multi-heading Morison excitation, external-QTF second-order forces,
@@ -223,7 +225,22 @@ def make_full_evaluator(model, nWaves=1, turb_static=None):
     Static per evaluator: nWaves, spectrum type (JONSWAP), operating
     turbine status, and the turbulence *class* (``turb_static``
     overrides the (TurbMod, V_ref_cls) pair, default NTM/class-I).
+
+    geometry=True enables the traced GEOMETRY design axis — the WEIS
+    design variables (member diameters/thicknesses, ballast fills,
+    mooring length/stiffness; omdao_raft.py:26-343,
+    parametersweep.py:56-100): ``case`` may then carry a ``geom`` dict
+    (keys of :func:`raft_tpu.structure.members_traced.apply_geometry`
+    plus ``L_moor_scale`` / ``EA_moor_scale``), statics + hydro
+    constants are recomputed in-trace from the traced member geometry,
+    and ONE compilation serves an entire geometry DoE — differentiable
+    end-to-end (``jax.grad`` of any response metric wrt any geometry
+    parameter via the implicit-function-theorem fixed points).
+    Potential-flow coefficients (absent on the strip-theory flagship
+    designs) are not re-solved under geometry scaling.
     """
+    import dataclasses
+
     fs = model.fowtList[0]
     assert model.nFOWT == 1, "full traced evaluator covers single-FOWT models"
     assert fs.is_single_body, "full traced evaluator covers rigid 6-DOF FOWTs"
@@ -256,6 +273,10 @@ def make_full_evaluator(model, nWaves=1, turb_static=None):
         A_BEM[:6, :6, :] = bem["A_BEM"]
         B_BEM[:6, :6, :] = bem["B_BEM"]
     has_X = bem is not None and np.any(np.abs(bem["X_BEM"]) > 0)
+    if geometry and bem is not None:
+        raise ValueError(
+            "geometry tracing requires strip-theory-only designs: "
+            "potential-flow coefficients are not re-solved per geometry")
 
     # external difference-frequency QTF on the model grid
     qtf = model.qtf
@@ -280,6 +301,33 @@ def make_full_evaluator(model, nWaves=1, turb_static=None):
         gamma = jnp.atleast_1d(jnp.asarray(case.get("gamma", 0.0)) * jnp.ones(nWaves))
         beta_deg = jnp.atleast_1d(jnp.asarray(case.get("beta_deg", 0.0)) * jnp.ones(nWaves))
         beta = jnp.deg2rad(beta_deg)
+
+        # ---- traced geometry axis (see docstring)
+        ss_t, ms_t = ss, ms
+        K_h_t, C_elast_t, F_und_t = K_h, C_elast, F_und
+        M_struc_t, A_hydro_t, hc0_t = M_struc, A_hydro, hc0
+        if geometry:
+            from raft_tpu.structure.members_traced import apply_geometry
+
+            geom = case.get("geom", {})
+            fs2, ss_t = apply_geometry(fs, ss, geom, k=k)
+            stat_t = calc_statics(fs2)
+            K_h_t = stat_t["C_struc"] + stat_t["C_hydro"]
+            C_elast_t = stat_t["C_elast"]
+            F_und_t = stat_t["W_struc"] + stat_t["W_hydro"] + stat_t["f0_additional"]
+            M_struc_t = stat_t["M_struc"]
+            hc0_t = morison.hydro_constants(
+                fs2, ss_t, jnp.eye(3), r0_nodes, Tn0)
+            from raft_tpu.models.hydro import add_rotor_added_mass
+
+            A_hydro_t = add_rotor_added_mass(hc0_t["A_hydro"], fs, Tn0)
+            hc0_t = dict(hc0_t, A_hydro=A_hydro_t)
+            if ms is not None:
+                ms_t = dataclasses.replace(
+                    ms,
+                    L=jnp.asarray(ms.L) * geom.get("L_moor_scale", 1.0),
+                    EA=jnp.asarray(ms.EA) * geom.get("EA_moor_scale", 1.0),
+                )
 
         # ---- aero-servo constants about the rotor nodes (zero-pose Tn,
         # matching the reference's calcTurbineConstants-at-case-start)
@@ -320,30 +368,30 @@ def make_full_evaluator(model, nWaves=1, turb_static=None):
 
         # ---- current loads at the reference pose
         F_current = morison.current_loads(
-            fs, ss, hc0, cur_speed, cur_heading,
+            fs, ss_t, hc0_t, cur_speed, cur_heading,
             min([r.Zhub for r in fs.rotors if r.Zhub < 0], default=0.0),
             Tn0, jnp.asarray(fs.node_r0))
 
         # ---- mean-offset equilibrium under environmental mean loads
         from raft_tpu.models.statics_solve import solve_equilibrium_general, single_ms_closures
-        force, stiff = single_ms_closures(ms, nDOF)
+        force, stiff = single_ms_closures(ms_t, nDOF)
         F_env = F_current + f_aero0
         X0, _ = solve_equilibrium_general(
-            jnp.asarray(K_h), jnp.asarray(F_und), F_env, force, stiff,
-            tol_vec, caps, refs, C_elast=jnp.asarray(C_elast))
+            jnp.asarray(K_h_t), jnp.asarray(F_und_t), F_env, force, stiff,
+            tol_vec, caps, refs, C_elast=jnp.asarray(C_elast_t))
 
         # ---- pose-dependent strip frames
         r_nodes, R_ptfm, r_root = platform_kinematics(fs, X0)
         Tn = node_T(r_nodes, r_root)
-        r, q, p1, p2 = morison.strip_frames(ss, R_ptfm, r_nodes)
+        r, q, p1, p2 = morison.strip_frames(ss_t, R_ptfm, r_nodes)
         sub = r[:, 2] < 0
-        hc = dict(hc0, r=r, q=q, p1=p1, p2=p2, sub=sub,
-                  active=sub & jnp.asarray(ss.active))
+        hc = dict(hc0_t, r=r, q=q, p1=p1, p2=p2, sub=sub,
+                  active=sub & jnp.asarray(ss_t.active))
 
         # ---- sea states + first-order excitation (all headings)
         S = jax.vmap(lambda h, t, g_: wv.jonswap(w, h, t, gamma=g_))(Hs, Tp, gamma)
         zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
-        exc = morison.hydro_excitation(fs, ss, hc, zeta, beta, w, k, Tn, r_nodes)
+        exc = morison.hydro_excitation(fs, ss_t, hc, zeta, beta, w, k, Tn, r_nodes)
 
         F_BEM = jnp.zeros((nWaves, nDOF, nw), dtype=complex)
         if has_X:
@@ -370,21 +418,21 @@ def make_full_evaluator(model, nWaves=1, turb_static=None):
         # ---- linear system (raft_model.py:1045-1048)
         C_moor = jnp.zeros((nDOF, nDOF))
         if ms is not None:
-            C_moor = C_moor.at[:6, :6].add(mooring_stiffness(ms, X0[:6]))
-        M_lin = A_aero + (M_struc + A_hydro)[:, :, None] + jnp.asarray(A_BEM)
+            C_moor = C_moor.at[:6, :6].add(mooring_stiffness(ms_t, X0[:6]))
+        M_lin = A_aero + (M_struc_t + A_hydro_t)[:, :, None] + jnp.asarray(A_BEM)
         B_lin = B_aero + jnp.asarray(B_BEM) + B_gyro[:, :, None]
-        C_lin = jnp.asarray(K_h) + C_moor + jnp.asarray(C_elast)
+        C_lin = jnp.asarray(K_h_t) + C_moor + jnp.asarray(C_elast_t)
         F_lin = F_BEM[0] + exc["F_hydro_iner"][0] + F_2nd[0]
 
-        Z, _, Bmat = solve_dynamics_fowt(
-            fs, ss, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
+        Z, _, Bmat, dyn_diag = solve_dynamics_fowt(
+            fs, ss_t, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
             w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart)
 
         # ---- per-heading responses + zero rotor-source row
         # (reference leaves the rotor excitation row zero,
         # raft_model.py:1246-1255)
         def fwave_one(ih):
-            F_drag = morison.drag_excitation(fs, ss, hc, Bmat, exc["u"][ih],
+            F_drag = morison.drag_excitation(fs, ss_t, hc, Bmat, exc["u"][ih],
                                              Tn, r_nodes)
             return F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag + F_2nd[ih]
         F_waves = jnp.stack([fwave_one(ih) for ih in range(nWaves)])
@@ -396,9 +444,9 @@ def make_full_evaluator(model, nWaves=1, turb_static=None):
         X0_out = X0
         if Qm is not None:
             X0_out, _ = solve_equilibrium_general(
-                jnp.asarray(K_h), jnp.asarray(F_und),
+                jnp.asarray(K_h_t), jnp.asarray(F_und_t),
                 F_env + jnp.sum(F_2nd_mean, axis=0), force, stiff,
-                tol_vec, caps, refs, C_elast=jnp.asarray(C_elast))
+                tol_vec, caps, refs, C_elast=jnp.asarray(C_elast_t))
 
         RAO = wv.get_rao(Xi[0], zeta[0])
         PSD = jnp.sum(0.5 * jnp.abs(Xi) ** 2 / dw, axis=0)
@@ -407,6 +455,8 @@ def make_full_evaluator(model, nWaves=1, turb_static=None):
             f_aero=f_aero, A00=A00, B00=B00, f_aero0=f_aero0,
             Omega_rpm=Om_out, pitch_deg=pitch_out,
             F_2nd_mean=F_2nd_mean, Z=Z,
+            drag_resid=dyn_diag["drag_resid"],
+            drag_converged=dyn_diag["drag_converged"],
         )
 
     return evaluate
@@ -466,7 +516,7 @@ def make_case_evaluator(model, n_stat_iter=12):
         C_lin = K_h + C_moor
         F_lin = exc["F_hydro_iner"][0]
 
-        Z, Xi1, Bmat = solve_dynamics_fowt(
+        Z, Xi1, Bmat, dyn_diag = solve_dynamics_fowt(
             fs, ss, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
             w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart,
         )
@@ -477,6 +527,8 @@ def make_case_evaluator(model, n_stat_iter=12):
 
         RAO = wv.get_rao(Xi, zeta)
         PSD = 0.5 * jnp.abs(Xi) ** 2 / dw
-        return dict(X0=X0, Xi=Xi, RAO=RAO, PSD=PSD, S=S)
+        return dict(X0=X0, Xi=Xi, RAO=RAO, PSD=PSD, S=S,
+                    drag_resid=dyn_diag["drag_resid"],
+                    drag_converged=dyn_diag["drag_converged"])
 
     return evaluate

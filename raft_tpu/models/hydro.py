@@ -53,6 +53,18 @@ def make_sea_state(case, w):
     return S, zeta, beta
 
 
+def add_rotor_added_mass(A, fs, Tn):
+    """Add the submerged (MHK) rotor blade added mass about each rotor
+    node (raft_fowt.py:1618-1625).  Shared by the host-side FOWTHydro
+    build and the traced geometry evaluator so the two paths cannot
+    diverge.  A (nDOF, nDOF); Tn (N, 6, nDOF) node reduction rows."""
+    for ir, rot in enumerate(fs.rotors):
+        if rot.hydro is not None:
+            Tn_n = jnp.asarray(Tn[int(fs.rotor_node[ir])])
+            A = A + Tn_n.T @ jnp.asarray(rot.hydro["A_hydro"]) @ Tn_n
+    return A
+
+
 class FOWTHydro:
     """Per-FOWT hydro state: strips + pose-dependent tensors."""
 
@@ -75,13 +87,8 @@ class FOWTHydro:
                 morison.hydro_constants(fs, self.strips, R0, r0_nodes, Tn0)
             )
             # submerged (MHK) rotor added mass via blade members
-            # (raft_fowt.py:1618-1625)
-            for ir, rot in enumerate(fs.rotors):
-                if rot.hydro is not None:
-                    node = int(fs.rotor_node[ir])
-                    Tn_n = np.asarray(Tn0[node])  # (6, nDOF)
-                    self.hc0["A_hydro"] = np.asarray(self.hc0["A_hydro"]) + (
-                        Tn_n.T @ np.asarray(rot.hydro["A_hydro"]) @ Tn_n)
+            self.hc0["A_hydro"] = np.asarray(
+                add_rotor_added_mass(jnp.asarray(self.hc0["A_hydro"]), fs, Tn0))
             self.set_position(np.zeros(fs.nDOF))
 
     def _kinematics(self, Xi0):
@@ -97,18 +104,13 @@ class FOWTHydro:
             r_nodes, R_ptfm, r_root = platform_kinematics(fs, Xi0)
             Tn = node_T(r_nodes, r_root)
             return r_nodes, R_ptfm, r_root, Tn
-        # nonlinear rigid-link/beam mean-offset kinematics
-        # (setNodesPosition, raft_fowt.py:669-752)
-        disp = fs.topology.displacements(
+        # nonlinear rigid-link/beam mean-offset kinematics at the
+        # self-consistent displaced pose (setNodesPosition + reduceDOF
+        # fixed point — the reference reaches it by calling setPosition
+        # at every statics-solver evaluation, raft_fowt.py:669-780)
+        disp, T_disp = fs.topology.self_consistent_displacements(
             fs.T, fs.reducedDOF, fs.root_id, np.asarray(Xi0))
         r_np = fs.node_r0 + disp[:, :3]
-        # T depends on the current node positions through the rigid-link
-        # offsets (reference recomputes reduceDOF after setPosition,
-        # raft_fowt.py:774); rebuild it at the displaced positions
-        if np.any(disp):
-            T_disp, _, _ = fs.topology.reduce(positions=r_np)
-        else:
-            T_disp = fs.T
         r_nodes = jnp.asarray(r_np)
         Tn = jnp.asarray(T_disp.reshape(fs.n_nodes, 6, fs.nDOF))
         self._node_rot = jnp.asarray(disp[:, 3:])  # member axes track node rotations

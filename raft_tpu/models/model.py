@@ -450,7 +450,7 @@ class Model:
                     F_2nd_mean[ih, offs[i]:offs[i] + 6] = fm[:6]
                 F_lin = F_lin + F_2nd[0]
 
-            Z_i, Xi_i, Bmat = solve_dynamics_fowt(
+            Z_i, Xi_i, Bmat, dyn_diag = solve_dynamics_fowt(
                 fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
                 jnp.asarray(self.w), fh.Tn, fh.r_nodes,
                 n_iter=self.nIter, Xi_start=self.XiStart, Z_extra=Z_moor,
@@ -474,14 +474,22 @@ class Model:
                     F_2nd = F_2nd.at[ih, :6, :].add(jnp.asarray(f2[:6]))
                     F_2nd_mean[ih, offs[i]:offs[i] + 6] += fm[:6]
                 F_lin = F_lin + F_2nd[0]
-                Z_i, Xi_i, Bmat = solve_dynamics_fowt(
+                Z_i, Xi_i, Bmat, dyn_diag = solve_dynamics_fowt(
                     fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
                     jnp.asarray(self.w), fh.Tn, fh.r_nodes,
                     n_iter=self.nIter, Xi_start=self.XiStart,
                 )
             Z_blocks.append(Z_i)
             Bmats.append(Bmat)
-            infos.append(dict(S=fh.S, zeta=fh.zeta, exc=exc, tc=tc))
+            if not bool(dyn_diag["drag_converged"]):
+                import warnings
+
+                warnings.warn(
+                    "solveDynamics drag linearisation did not converge to "
+                    f"tolerance (residual {float(dyn_diag['drag_resid']):.2e}) "
+                    "for FOWT %d" % i)
+            infos.append(dict(S=fh.S, zeta=fh.zeta, exc=exc, tc=tc,
+                              dyn_diag=dyn_diag))
             for ih in range(nWaves):
                 F_drag = fh.drag_excitation(Bmat, ih)
                 F_waves[ih].append(

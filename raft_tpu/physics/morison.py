@@ -45,6 +45,7 @@ class StripSet:
     drs: np.ndarray       # (S,2)
     circ: np.ndarray      # (S,) bool
     active: np.ndarray    # (S,) bool — False for potMod members (no Morison)
+    mcf: np.ndarray       # (S,) bool — MacCamy-Fuchs members
     q0: np.ndarray        # (S,3) member axes at reference pose
     p10: np.ndarray
     p20: np.ndarray
@@ -64,13 +65,63 @@ class StripSet:
         return len(self.ls)
 
 
+_MCF_TABLE = None
+_MCF_KR_MAX = 60.0
+_MCF_N = 1 << 20
+
+
+def mcf_cm(kR):
+    """MacCamy-Fuchs complex inertia coefficient Cm(kR) = 4i/(pi (kR)^2
+    H1'(kR)) as a universal function of kR (raft_member.py:1467-1478).
+
+    Evaluated through one dense precomputed table (~3e-10 relative
+    interp error) so the numpy build path and the traced geometry path
+    (kR = k * R * d_scale) produce identical values.  Works on numpy or
+    jax arrays of any shape.
+    """
+    global _MCF_TABLE
+    if _MCF_TABLE is None:
+        from scipy.special import hankel1
+
+        x = np.linspace(0.0, _MCF_KR_MAX, _MCF_N)
+        with np.errstate(all="ignore"):
+            Hp1 = 0.5 * (hankel1(0, x) - hankel1(2, x))
+            Cm = 4j / (np.pi * x**2 * Hp1)
+        _MCF_TABLE = (np.nan_to_num(Cm.real), np.nan_to_num(Cm.imag))
+    re, im = _MCF_TABLE
+    dx = _MCF_KR_MAX / (_MCF_N - 1)
+    if isinstance(kR, jnp.ndarray):
+        xq = jnp.clip(kR, 0.0, _MCF_KR_MAX)
+        i = jnp.clip((xq / dx).astype(int), 0, _MCF_N - 2)
+        f = xq / dx - i
+        re_j, im_j = jnp.asarray(re), jnp.asarray(im)
+        return (re_j[i] * (1 - f) + re_j[i + 1] * f) + 1j * (
+            im_j[i] * (1 - f) + im_j[i + 1] * f)
+    xq = np.clip(np.asarray(kR, dtype=float), 0.0, _MCF_KR_MAX)
+    i = np.clip((xq / dx).astype(int), 0, _MCF_N - 2)
+    f = xq / dx - i
+    return (re[i] * (1 - f) + re[i + 1] * f) + 1j * (im[i] * (1 - f) + im[i + 1] * f)
+
+
+def mcf_blend(kR, Cm0_p1, Cm0_p2):
+    """Blend the MCF Cm(kR) with the baseline (1+Ca) coefficients using
+    the reference's long-wave ramp (raft_member.py:1479-1484); the ramp
+    threshold k < pi/(5R) is kR < pi/5, so everything is a function of
+    kR.  Returns (Cm_p1, Cm_p2) broadcast over kR's shape."""
+    xp = jnp if isinstance(kR, jnp.ndarray) else np
+    Cm = mcf_cm(kR)
+    ramp = xp.where(kR < np.pi / 5, 0.5 * (1 - xp.cos(5 * kR)), 1.0)
+    ramp = xp.where(kR <= 0, 0.0, ramp)
+    return Cm * ramp + Cm0_p1 * (1 - ramp), Cm * ramp + Cm0_p2 * (1 - ramp)
+
+
 def build_strips(fs, k_array=None):
     """Flatten all members' strips; optionally bake MCF Cm(k) factors.
 
     fs : FOWTStructure;  k_array : (nw,) wave numbers for MCF members.
     """
     cols = {f: [] for f in (
-        "node mnode0 ls dls ds drs circ active q0 p10 p20 "
+        "node mnode0 ls dls ds drs circ active mcf q0 p10 p20 "
         "Cd_q Cd_p1 Cd_p2 Cd_End Ca_q Ca_p1 Ca_p2 Ca_End".split()
     )}
     mcf_rows = []
@@ -90,6 +141,7 @@ def build_strips(fs, k_array=None):
         cols["drs"] += list(mem.drs)
         cols["circ"] += [mem.circular] * ns
         cols["active"] += [not mem.potMod] * ns
+        cols["mcf"] += [bool(mem.MCF) and k_array is not None] * ns
         cols["q0"] += [mem.q0] * ns
         cols["p10"] += [mem.p10] * ns
         cols["p20"] += [mem.p20] * ns
@@ -100,18 +152,9 @@ def build_strips(fs, k_array=None):
             Cm0_p1 = 1.0 + mem.Ca_p1[il]
             Cm0_p2 = 1.0 + mem.Ca_p2[il]
             if mem.MCF and k_array is not None:
-                from scipy.special import hankel1
-
                 R = mem.ds[il, 0] / 2.0
                 k = np.asarray(k_array)
-                with np.errstate(all="ignore"):
-                    Hp1 = 0.5 * (hankel1(0, k * R) - hankel1(2, k * R))
-                    Cm = 4j / (np.pi * (k * R) ** 2 * Hp1)
-                Tr = np.pi / 5 / R
-                ramp = np.where(k < Tr, 0.5 * (1 - np.cos(np.pi * k / Tr)), 1.0)
-                ramp = np.where(k <= 0, 0.0, ramp)
-                Cm_p1 = Cm * ramp + Cm0_p1 * (1 - ramp)
-                Cm_p2 = Cm * ramp + Cm0_p2 * (1 - ramp)
+                Cm_p1, Cm_p2 = mcf_blend(k * R, Cm0_p1, Cm0_p2)
                 mcf_rows.append((np.nan_to_num(Cm_p1), np.nan_to_num(Cm_p2)))
             else:
                 mcf_rows.append(

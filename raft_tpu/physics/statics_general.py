@@ -268,14 +268,12 @@ def calc_statics_general(fs, Xi0=None):
     node_r = fs.node_r0
     node_rot = None
     if Xi0 is not None and np.any(np.asarray(Xi0)):
-        disp = fs.topology.displacements(
+        # self-consistent displaced-pose kinematics (see
+        # Topology.self_consistent_displacements)
+        disp, T = fs.topology.self_consistent_displacements(
             fs.T, fs.reducedDOF, fs.root_id, np.asarray(Xi0, dtype=float))
         node_r = fs.node_r0 + disp[:, :3]
         node_rot = disp[:, 3:]
-        # T depends on the current node positions through the rigid-link
-        # offsets (reference recomputes reduceDOF after setPosition)
-        T, _, _ = fs.topology.reduce(positions=node_r)
-        fs.topology.reduce()  # restore reference-pose traversal state
 
     M_full = np.zeros((nF, nF))
     Msub_full = np.zeros((nF, nF))

@@ -161,6 +161,12 @@ class MemberGeometry:
     pfill: list = field(default_factory=list)
     vfill: list = field(default_factory=list)
 
+    # cap/bulkhead configuration (resolved axial positions; kept so the
+    # traced-geometry twin can recompute cap inertias for scaled d/t)
+    cap_L: np.ndarray | None = None       # (nc,) axial positions 0..l
+    cap_t_arr: np.ndarray | None = None   # (nc,) cap thicknesses
+    cap_d_in_arr: np.ndarray | None = None  # (nc,) or (nc,2) hole sizes
+
     # beam (flexible) member data
     E: float = 0.0
     G: float = 0.0
@@ -345,11 +351,32 @@ def build_member(mi, heading=0.0, part_of="platform", global_dlsMax=5.0):
         dorsl_node_ext=d_node_ext,
         dorsl_node_int=d_node_int,
     )
+    _parse_caps(geom, mi)
     if mtype == "beam":
         _build_beam_node_data(geom, mi)
     else:
         _build_inertia_elements(geom, mi)
     return geom
+
+
+def _parse_caps(g: MemberGeometry, mi):
+    """Resolve the cap/bulkhead configuration onto the geometry object
+    (axial positions scaled to member length, raft_member.py:161-176)."""
+    cap_stations_in = coerce(mi, "cap_stations", shape=-1, default=[])
+    if len(np.atleast_1d(cap_stations_in)) == 0:
+        g.cap_L = np.zeros(0)
+        g.cap_t_arr = np.zeros(0)
+        g.cap_d_in_arr = np.zeros(0)
+        return
+    cap_st_in = np.atleast_1d(np.array(cap_stations_in, dtype=float))
+    g.cap_t_arr = np.atleast_1d(coerce(mi, "cap_t", shape=cap_st_in.shape[0]))
+    if g.circular:
+        g.cap_d_in_arr = np.atleast_1d(
+            coerce(mi, "cap_d_in", shape=cap_st_in.shape[0]))
+    else:
+        g.cap_d_in_arr = coerce(mi, "cap_d_in", shape=[cap_st_in.shape[0], 2])
+    st0 = np.array(mi["stations"], dtype=float)
+    g.cap_L = (cap_st_in - st0[0]) / (st0[-1] - st0[0]) * g.l
 
 
 def _build_beam_node_data(g: MemberGeometry, mi):
@@ -420,7 +447,7 @@ def _build_beam_node_data(g: MemberGeometry, mi):
     center_c = np.zeros((ns, 3))
     I_c = np.zeros((ns, 3))
     m_caps_total = 0.0
-    for (m_cap, s_cg, Ix, Iy, Iz) in _cap_elements(g, mi):
+    for (m_cap, s_cg, Ix, Iy, Iz) in _cap_elements(g):
         center_cap = g.rA0 + g.q0 * s_cg
         inode = int(np.argmin(np.linalg.norm(
             (g.rA0[None, :] + g.q0[None, :] * nodes_s[:, None]) - center_cap[None, :],
@@ -444,20 +471,15 @@ def _build_beam_node_data(g: MemberGeometry, mi):
     g.vfill = vfill
 
 
-def _cap_elements(g: MemberGeometry, mi):
+def _cap_elements(g: MemberGeometry):
     """Cap/bulkhead inertia elements (raft_member.py:659-823):
-    list of (mass, axial CG offset, Ixx, Iyy, Izz about CG, local axes)."""
+    list of (mass, axial CG offset, Ixx, Iyy, Izz about CG, local axes).
+    Uses the cap configuration resolved by :func:`_parse_caps`."""
     out = []
-    cap_stations_in = coerce(mi, "cap_stations", shape=-1, default=[])
-    if len(np.atleast_1d(cap_stations_in)) > 0:
-        cap_st_in = np.atleast_1d(np.array(cap_stations_in, dtype=float))
-        cap_t = np.atleast_1d(coerce(mi, "cap_t", shape=cap_st_in.shape[0]))
-        if g.circular:
-            cap_d_in = np.atleast_1d(coerce(mi, "cap_d_in", shape=cap_st_in.shape[0]))
-        else:
-            cap_d_in = coerce(mi, "cap_d_in", shape=[cap_st_in.shape[0], 2])
-        st0 = np.array(mi["stations"], dtype=float)
-        cap_L = (cap_st_in - st0[0]) / (st0[-1] - st0[0]) * g.l
+    cap_L = g.cap_L
+    cap_t = g.cap_t_arr
+    cap_d_in = g.cap_d_in_arr
+    if cap_L is not None and len(cap_L) > 0:
 
         for ic in range(len(cap_L)):
             L = cap_L[ic]
@@ -670,7 +692,7 @@ def _build_inertia_elements(g: MemberGeometry, mi):
         pfill.append(float(rho_fill))
 
     # ----- caps / bulkheads (shared helper) -----
-    for (m_cap, s_cg, Ixx, Iyy, Izz) in _cap_elements(g, mi):
+    for (m_cap, s_cg, Ixx, Iyy, Izz) in _cap_elements(g):
         masses.append(m_cap)
         ss.append(s_cg)
         Ixxs.append(Ixx)

@@ -1,0 +1,419 @@
+"""Traced (jax) twin of the build-time member-element computation.
+
+The build-time path (:mod:`raft_tpu.structure.members`) reduces each
+member's shell/ballast/cap geometry to per-element inertia constants
+with numpy.  For the geometry design axis — the WEIS design variables
+``member_d`` / ``member_t`` / ballast fills / mooring properties
+(`/root/reference/raft/omdao_raft.py:26-343`,
+`parametersweep.py:56-100`) — those constants must instead be traced
+functions of the design parameters so ONE compiled evaluator serves an
+entire geometry DoE (SURVEY §7.1 build-time/trace-time split).
+
+This module re-derives the same element constants with ``jax.numpy``:
+
+* the *shapes* (station count, strip count, element count, cap branch
+  selection) are static — they depend only on the station layout;
+* the *values* (diameters, thicknesses, fill lengths/densities) are
+  traced inputs;
+* the reference's equal-endpoint special cases in the frustum/box MoI
+  formulas (helpers.py:65-146) are algebraic limits of the general
+  polynomial forms, so single branch-free expressions reproduce them
+  exactly (the ``(r2^5 - r1^5)/(r2 - r1)`` ratio is expanded to its
+  polynomial to stay finite at equality).
+
+Matches `/root/reference/raft/raft_member.py` getInertia :412-541 and
+the cap/bulkhead block :659-823 through the same element layout as
+``_build_inertia_elements`` / ``_cap_elements``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+# --------------------------------------------------------- geometry bits
+
+def vcv_circ(dA, dB, H):
+    """Frustum volume + axial centroid (helpers.py:36-63, circular)."""
+    A1 = jnp.pi / 4 * dA**2
+    A2 = jnp.pi / 4 * dB**2
+    Am = jnp.pi / 4 * dA * dB
+    s = A1 + Am + A2
+    V = s * H / 3.0
+    hc = jnp.where(s != 0, (A1 + 2 * Am + 3 * A2) / jnp.where(s != 0, s, 1.0) * H / 4.0, 0.0)
+    return V, hc
+
+
+def vcv_rect(slA, slB, H):
+    """Frustum volume + axial centroid (rectangular side pairs (2,))."""
+    A1 = slA[0] * slA[1]
+    A2 = slB[0] * slB[1]
+    Am = jnp.sqrt(jnp.maximum(A1 * A2, 0.0))
+    s = A1 + Am + A2
+    V = s * H / 3.0
+    hc = jnp.where(s != 0, (A1 + 2 * Am + 3 * A2) / jnp.where(s != 0, s, 1.0) * H / 4.0, 0.0)
+    return V, hc
+
+
+def moi_circ(dA, dB, H, rho):
+    """Circular frustum radial/axial MoI about end A (helpers.py:65-83).
+
+    The reference's dA==dB branch equals the limit of the general cone
+    expression; ``(r2^5-r1^5)/(r2-r1)`` is expanded to the 4th-degree
+    polynomial so one expression covers both."""
+    r1 = dA / 2.0
+    r2 = dB / 2.0
+    # (r2^5 - r1^5)/(r2 - r1) = sum_{j=0..4} r2^j r1^(4-j)
+    p4 = r2**4 + r2**3 * r1 + r2**2 * r1**2 + r2 * r1**3 + r1**4
+    I_rad = (1 / 20) * rho * jnp.pi * H * p4 + (1 / 30) * rho * jnp.pi * H**3 * (
+        r1**2 + 3 * r1 * r2 + 6 * r2**2)
+    I_ax = (1 / 10) * rho * jnp.pi * H * p4
+    zero = H == 0
+    return jnp.where(zero, 0.0, I_rad), jnp.where(zero, 0.0, I_ax)
+
+
+def moi_rect(La, Wa, Lb, Wb, H, rho):
+    """Box frustum MoI about end A (helpers.py:85-146).  The general
+    polynomial form; the reference's equal-side branches are exact
+    specialisations of it (verified algebraically)."""
+    x2 = (1 / 12) * rho * (
+        (Lb - La) ** 3 * H * (Wb / 5 + Wa / 20)
+        + (Lb - La) ** 2 * La * H * (3 * Wb / 4 + Wa / 4)
+        + (Lb - La) * La**2 * H * (Wb + Wa / 2)
+        + La**3 * H * (Wb / 2 + Wa / 2)
+    )
+    y2 = (1 / 12) * rho * (
+        (Wb - Wa) ** 3 * H * (Lb / 5 + La / 20)
+        + (Wb - Wa) ** 2 * Wa * H * (3 * Lb / 4 + La / 4)
+        + (Wb - Wa) * Wa**2 * H * (Lb + La / 2)
+        + Wa**3 * H * (Lb / 2 + La / 2)
+    )
+    z2 = rho * (Wb * Lb / 5 + Wa * Lb / 20 + La * Wb / 20 + Wa * La / 30) * H**3
+    zero = H == 0
+    Ixx = jnp.where(zero, 0.0, y2 + z2)
+    Iyy = jnp.where(zero, 0.0, x2 + z2)
+    Izz = jnp.where(zero, 0.0, x2 + y2)
+    return Ixx, Iyy, Izz
+
+
+def _interp1(x, xs, v):
+    """Linear interp of traced values ``v`` over STATIC abscissae ``xs``
+    at a STATIC query ``x`` — indices/weights resolve at trace time."""
+    xs = np.asarray(xs, dtype=float)
+    x = float(x)
+    if x <= xs[0]:
+        return v[0]
+    if x >= xs[-1]:
+        return v[-1]
+    i = int(np.searchsorted(xs, x, side="right") - 1)
+    f = (x - xs[i]) / (xs[i + 1] - xs[i])
+    return v[i] * (1 - f) + v[i + 1] * f
+
+
+def _sdiv(a, b):
+    return jnp.where(b != 0, a / jnp.where(b != 0, b, 1.0), 0.0)
+
+
+def traced_cap_elements(g, d, t):
+    """jax twin of members._cap_elements: list of
+    (mass, s_cg, Ixx, Iyy, Izz) with traced d (n,2) / t (n,).
+    Branch selection is static (station/cap layout)."""
+    out = []
+    cap_L = g.cap_L
+    if cap_L is None or len(cap_L) == 0:
+        return out
+    cap_t = g.cap_t_arr
+    cap_d_in = g.cap_d_in_arr
+    st = g.stations
+
+    for ic in range(len(cap_L)):
+        L = cap_L[ic]
+        h = cap_t[ic]
+        rho_cap = g.rho_shell
+        if g.circular:
+            d_hole = cap_d_in[ic]
+            d_in = d[:, 0] - 2 * t
+            if L == st[0]:
+                dA = d_in[0]
+                dB = _interp1(L + h, st, d_in)
+                dAi = d_hole
+                dBi = dB * _sdiv(dAi, dA)
+            elif L == st[-1]:
+                dA = _interp1(L - h, st, d_in)
+                dB = d_in[-1]
+                dBi = d_hole
+                dAi = dA * _sdiv(dBi, dB)
+            elif ic < len(cap_L) - 1 and L == cap_L[ic + 1]:
+                dA = _interp1(L - h, st, d_in)
+                dB = d_in[ic]
+                dBi = d_hole
+                dAi = dA * _sdiv(dBi, dB)
+            elif ic > 0 and L == cap_L[ic - 1]:
+                dA = d_in[ic]
+                dB = _interp1(L + h, st, d_in)
+                dAi = d_hole
+                dBi = dB * _sdiv(dAi, dA)
+            else:
+                dA = _interp1(L - h / 2, st, d_in)
+                dB = _interp1(L + h / 2, st, d_in)
+                dM = _interp1(L, st, d_in)
+                dMi = d_hole
+                dAi = dA * _sdiv(dMi, dM)
+                dBi = dB * _sdiv(dMi, dM)
+            V_o, hco = vcv_circ(dA, dB, h)
+            V_i, hci = vcv_circ(dAi, dBi, h)
+            v_cap = V_o - V_i
+            m_cap = v_cap * rho_cap
+            hc_cap = _sdiv(hco * V_o - hci * V_i, V_o - V_i)
+            Ir_o, Ia_o = moi_circ(dA, dB, h, rho_cap)
+            Ir_i, Ia_i = moi_circ(dAi, dBi, h, rho_cap)
+            I_rad = (Ir_o - Ir_i) - m_cap * hc_cap**2
+            Ixx = Iyy = I_rad
+            Izz = Ia_o - Ia_i
+        else:
+            sl_hole = jnp.asarray(cap_d_in[ic])
+            sl_in = d - 2 * t[:, None]
+
+            def interp2(x):
+                return jnp.stack([_interp1(x, st, sl_in[:, 0]),
+                                  _interp1(x, st, sl_in[:, 1])])
+
+            if L == st[0]:
+                slA = sl_in[0]
+                slB = interp2(L + h)
+                slAi = sl_hole
+                slBi = slB * (slAi / slA)
+            elif L == st[-1]:
+                slB = sl_in[-1]
+                slA = interp2(L - h)
+                slBi = sl_hole
+                slAi = slA * (slBi / slB)
+            elif ic < len(cap_L) - 1 and L == cap_L[ic + 1]:
+                slA = interp2(L - h)
+                slB = sl_in[ic]
+                slBi = sl_hole
+                slAi = slA * (slBi / slB)
+            elif ic > 0 and L == cap_L[ic - 1]:
+                slA = sl_in[ic]
+                slB = interp2(L + h)
+                slAi = sl_hole
+                slBi = slB * (slAi / slA)
+            else:
+                slA = interp2(L - h / 2)
+                slB = interp2(L + h / 2)
+                slM = interp2(L)
+                slMi = sl_hole
+                slAi = slA * (slMi / slM)
+                slBi = slB * (slMi / slM)
+            V_o, hco = vcv_rect(slA, slB, h)
+            V_i, hci = vcv_rect(slAi, slBi, h)
+            v_cap = V_o - V_i
+            m_cap = v_cap * rho_cap
+            hc_cap = _sdiv(hco * V_o - hci * V_i, V_o - V_i)
+            Ix_o, Iy_o, Iz_o = moi_rect(slA[0], slA[1], slB[0], slB[1], h, rho_cap)
+            Ix_i, Iy_i, Iz_i = moi_rect(slAi[0], slAi[1], slBi[0], slBi[1], h, rho_cap)
+            Ixx = (Ix_o - Ix_i) - m_cap * hc_cap**2
+            Iyy = (Iy_o - Iy_i) - m_cap * hc_cap**2
+            Izz = Iz_o - Iz_i
+
+        if L == st[0]:
+            s_cg = L + hc_cap
+        elif L == st[-1]:
+            s_cg = L - (h - hc_cap)
+        else:
+            s_cg = L - (h / 2 - hc_cap)
+        out.append((m_cap, s_cg, Ixx, Iyy, Izz))
+    return out
+
+
+def traced_inertia_elements(g, d, t, l_fill, rho_fill):
+    """jax twin of members._build_inertia_elements for RIGID members.
+
+    d : (n, 2) traced outer diameter/side pairs at stations
+    t : (n,)  traced shell thickness
+    l_fill : (n-1,) traced ballast fill lengths [m]
+    rho_fill : (n-1,) traced ballast densities
+
+    Returns (elem_mass, elem_s, elem_Ixx, elem_Iyy, elem_Izz) jnp arrays
+    with exactly the static element layout of the build-time path
+    (sections incl. the reference's zero-length-section quirk, then
+    caps), plus (mshell, mfill (n-1,)).
+    """
+    st = g.stations
+    n = len(st)
+    masses, ss, Ixxs, Iyys, Izzs = [], [], [], [], []
+    mshell = jnp.asarray(0.0)
+    mfill = []
+
+    for i in range(1, n):
+        lsec = float(st[i] - st[i - 1])
+        if lsec <= 0:
+            # zero-length-section quirk: re-adds the previous section's
+            # CG inertia with zero mass (members.py:597-614)
+            if masses:
+                masses.append(jnp.asarray(0.0))
+                ss.append(jnp.asarray(0.0))
+                Ixxs.append(Ixxs[-1])
+                Iyys.append(Iyys[-1])
+                Izzs.append(Izzs[-1])
+            mfill.append(jnp.asarray(0.0))
+            continue
+        lf = l_fill[i - 1]
+        rf = rho_fill[i - 1]
+
+        if g.circular:
+            dA, dB = d[i - 1, 0], d[i, 0]
+            dAi = dA - 2 * t[i - 1]
+            dBi = dB - 2 * t[i]
+            V_o, hco = vcv_circ(dA, dB, lsec)
+            V_i, hci = vcv_circ(dAi, dBi, lsec)
+            m_shell = (V_o - V_i) * g.rho_shell
+            hc_shell = _sdiv(hco * V_o - hci * V_i, V_o - V_i)
+            dBi_fill = (dBi - dAi) * (lf / lsec) + dAi
+            v_fill, hc_fill = vcv_circ(dAi, dBi_fill, lf)
+            m_fill = v_fill * rf
+            mass = m_shell + m_fill
+            hc = _sdiv(hc_fill * m_fill + hc_shell * m_shell, mass)
+            Ir_o, Ia_o = moi_circ(dA, dB, lsec, g.rho_shell)
+            Ir_i, Ia_i = moi_circ(dAi, dBi, lsec, g.rho_shell)
+            Ir_f, Ia_f = moi_circ(dAi, dBi_fill, lf, rf)
+            I_rad = (Ir_o - Ir_i) + Ir_f - mass * hc**2
+            Ixx, Iyy, Izz = I_rad, I_rad, (Ia_o - Ia_i) + Ia_f
+        else:
+            slA, slB = d[i - 1], d[i]
+            slAi = slA - 2 * t[i - 1]
+            slBi = slB - 2 * t[i]
+            V_o, hco = vcv_rect(slA, slB, lsec)
+            V_i, hci = vcv_rect(slAi, slBi, lsec)
+            m_shell = (V_o - V_i) * g.rho_shell
+            hc_shell = _sdiv(hco * V_o - hci * V_i, V_o - V_i)
+            slBi_fill = (slBi - slAi) * (lf / lsec) + slAi
+            v_fill, hc_fill = vcv_rect(slAi, slBi_fill, lf)
+            m_fill = v_fill * rf
+            mass = m_shell + m_fill
+            hc = _sdiv(hc_fill * m_fill + hc_shell * m_shell, mass)
+            Ix_o, Iy_o, Iz_o = moi_rect(slA[0], slA[1], slB[0], slB[1], lsec, g.rho_shell)
+            Ix_i, Iy_i, Iz_i = moi_rect(slAi[0], slAi[1], slBi[0], slBi[1], lsec, g.rho_shell)
+            Ix_f, Iy_f, Iz_f = moi_rect(slAi[0], slAi[1], slBi_fill[0], slBi_fill[1], lf, rf)
+            Ixx = (Ix_o - Ix_i) + Ix_f - mass * hc**2
+            Iyy = (Iy_o - Iy_i) + Iy_f - mass * hc**2
+            Izz = (Iz_o - Iz_i) + Iz_f
+
+        masses.append(mass)
+        ss.append(st[i - 1] + hc)
+        Ixxs.append(Ixx)
+        Iyys.append(Iyy)
+        Izzs.append(Izz)
+        mshell = mshell + m_shell
+        mfill.append(m_fill)
+
+    for (m_cap, s_cg, Ixx, Iyy, Izz) in traced_cap_elements(g, d, t):
+        masses.append(m_cap)
+        ss.append(s_cg)
+        Ixxs.append(Ixx)
+        Iyys.append(Iyy)
+        Izzs.append(Izz)
+        mshell = mshell + m_cap
+
+    return (jnp.stack([jnp.asarray(x, dtype=float) for x in masses]),
+            jnp.stack([jnp.asarray(x, dtype=float) for x in ss]),
+            jnp.stack([jnp.asarray(x, dtype=float) for x in Ixxs]),
+            jnp.stack([jnp.asarray(x, dtype=float) for x in Iyys]),
+            jnp.stack([jnp.asarray(x, dtype=float) for x in Izzs]),
+            mshell,
+            jnp.stack([jnp.asarray(x, dtype=float) for x in mfill])
+            if mfill else jnp.zeros(0))
+
+
+# --------------------------------------------------------- FOWT assembly
+
+def apply_geometry(fs, ss0, params, k=None):
+    """Apply a traced geometry-parameter pytree to a FOWT.
+
+    params keys (all optional; broadcastable scalars or (nMember,)):
+      d_scale     outer diameter/side multiplier per member
+      t_scale     shell thickness multiplier per member
+      fill_scale  ballast fill-length multiplier per member
+      rho_fill_scale  ballast density multiplier per member
+      Cd_scale, Ca_scale  strip coefficient multipliers (global)
+
+    Returns (fs2, ss2): a shallow FOWT copy whose rigid members carry
+    traced d/t/elem_* (feeding the jax calc_statics/hydrostatics), and
+    a StripSet with rescaled strip diameters.  Geometry tracing covers
+    rigid members (the flagship workloads); flexible members keep their
+    build-time FE constants.  MacCamy-Fuchs Cm factors are re-evaluated
+    in-trace at the scaled kR through the canonical
+    :func:`raft_tpu.physics.morison.mcf_cm` table (pass ``k`` (nw,)
+    when the design has MCF members).
+    """
+    import copy
+    import dataclasses
+
+    nm = len(fs.members)
+    one = jnp.ones(nm)
+    d_s = jnp.broadcast_to(jnp.asarray(params.get("d_scale", 1.0)) * one, (nm,))
+    t_s = jnp.broadcast_to(jnp.asarray(params.get("t_scale", 1.0)) * one, (nm,))
+    f_s = jnp.broadcast_to(jnp.asarray(params.get("fill_scale", 1.0)) * one, (nm,))
+    rf_s = jnp.broadcast_to(jnp.asarray(params.get("rho_fill_scale", 1.0)) * one, (nm,))
+
+    members2 = []
+    for im, mem in enumerate(fs.members):
+        if mem.mtype != "rigid":
+            members2.append(mem)
+            continue
+        d = jnp.asarray(mem.d) * d_s[im]
+        t = jnp.asarray(mem.t) * t_s[im]
+        lf = jnp.asarray(mem.l_fill) * f_s[im]
+        rf = jnp.asarray(mem.rho_fill) * rf_s[im]
+        em, es, ex, ey, ez, mshell, mfill = traced_inertia_elements(mem, d, t, lf, rf)
+        members2.append(dataclasses.replace(
+            mem, d=d, t=t, l_fill=lf, rho_fill=rf,
+            ds=jnp.asarray(mem.ds) * d_s[im], drs=jnp.asarray(mem.drs) * d_s[im],
+            elem_mass=em, elem_s=es, elem_Ixx=ex, elem_Iyy=ey, elem_Izz=ez,
+        ))
+    fs2 = copy.copy(fs)
+    fs2.members = members2
+
+    # strip tensors: per-strip member scale (strip diameters are linear
+    # in the station diameters for a fixed station layout)
+    strip_mem = np.concatenate(
+        [np.full(m.ns, i, dtype=int) for i, m in enumerate(fs.members)])
+    sd = d_s[jnp.asarray(strip_mem)]
+    Cd_s = jnp.asarray(params.get("Cd_scale", 1.0))
+    Ca_s = jnp.asarray(params.get("Ca_scale", 1.0))
+    ds2 = jnp.asarray(ss0.ds) * sd[:, None]
+    Ca_p1_2 = jnp.asarray(ss0.Ca_p1) * Ca_s
+    Ca_p2_2 = jnp.asarray(ss0.Ca_p2) * Ca_s
+    # inertia coefficient tables: plain (1+Ca) strips scale with Ca; MCF
+    # strips re-evaluate the wave-diffraction factor at the scaled kR
+    Cm_p1_w = 1.0 + Ca_s * (jnp.asarray(ss0.Cm_p1_w) - 1.0)
+    Cm_p2_w = 1.0 + Ca_s * (jnp.asarray(ss0.Cm_p2_w) - 1.0)
+    mcf = np.asarray(ss0.mcf, dtype=bool)
+    if mcf.any():
+        from raft_tpu.physics.morison import mcf_blend
+
+        if k is None:
+            raise ValueError("apply_geometry needs k (nw,) for MCF members")
+        kR = jnp.asarray(k)[None, :] * (ds2[:, 0] / 2.0)[:, None]
+        Cm1_new, Cm2_new = mcf_blend(
+            kR, (1.0 + Ca_p1_2)[:, None], (1.0 + Ca_p2_2)[:, None])
+        sel = jnp.asarray(mcf)[:, None]
+        Cm_p1_w = jnp.where(sel, Cm1_new, Cm_p1_w)
+        Cm_p2_w = jnp.where(sel, Cm2_new, Cm_p2_w)
+    ss2 = dataclasses.replace(
+        ss0,
+        ds=ds2,
+        drs=jnp.asarray(ss0.drs) * sd[:, None],
+        Cd_q=jnp.asarray(ss0.Cd_q) * Cd_s,
+        Cd_p1=jnp.asarray(ss0.Cd_p1) * Cd_s,
+        Cd_p2=jnp.asarray(ss0.Cd_p2) * Cd_s,
+        Cd_End=jnp.asarray(ss0.Cd_End) * Cd_s,
+        Ca_q=jnp.asarray(ss0.Ca_q) * Ca_s,
+        Ca_p1=Ca_p1_2,
+        Ca_p2=Ca_p2_2,
+        Ca_End=jnp.asarray(ss0.Ca_End) * Ca_s,
+        Cm_p1_w=Cm_p1_w,
+        Cm_p2_w=Cm_p2_w,
+    )
+    return fs2, ss2

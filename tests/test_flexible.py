@@ -3,11 +3,19 @@
 headings, 150 reduced DOFs).
 
 Statics, hydro constants/linearisation/current loads, static
-equilibrium and natural frequencies match at (or near) the reference's
-own tolerances.  The end-to-end dynamics PSDs agree at golden level: the
-residual is the linear mean-offset kinematics used for general
-structures (the reference applies nonlinear rigid-link rotations,
-raft_fowt.py:686-752) — documented follow-up.
+equilibrium, natural frequencies AND the end-to-end dynamics PSDs match
+at golden level (~1e-9).  Two solver-semantics details were required
+for the dynamics (root-caused in round 3; previously an unexplained
+~1e-3 deviation blamed on test ordering):
+
+* cap-limited drag linearisation keeps the response of the LAST
+  LINEARISATION POINT — one under-relaxation fewer than a naive loop
+  (raft_model.py:1133-1143; this design runs nIter=4, cap-limited,
+  with the reference's own non-convergence warning);
+* displaced-pose node kinematics lag the statics solver by one step —
+  node positions use the build-time T, the rebuilt T applies only to
+  the load projections (setNodesPosition/reduceDOF path,
+  raft_fowt.py:753-780; `Topology.self_consistent_displacements`).
 """
 
 import os
@@ -108,21 +116,18 @@ def test_flexible_dynamics(model):
     for name in ("surge", "heave", "pitch", "yaw"):
         a = np.asarray(metrics[f"{name}_PSD"])
         b = np.asarray(tm[f"{name}_PSD"])
-        # golden-level parity: the nonlinear rigid-link/beam mean-offset
-        # kinematics (setNodesPosition equivalent) closes the former
-        # ~0.4% linear-kinematics residual to ~1e-9 — asserted at that
-        # level by test_flexible_dynamics_standalone_parity below when
-        # this module runs first.  When other suites run earlier in the
-        # same pytest process an order-dependent deviation up to the old
-        # linear-kinematics level reappears (same code and inputs; a
-        # plain-script farm-then-flexible reproduction is bitwise
-        # identical, so it is not Model-level shared state — tracked for
-        # round 3).  This gate therefore stays at the order-independent
-        # 5e-3 level.
-        assert np.max(np.abs(a - b) / (np.abs(b) + 1e-6)) < 5e-3, name
+        # golden-level parity (measured ~2.5e-9 worst channel; see
+        # module docstring for the two solver-semantics details)
+        assert np.max(np.abs(a - b) / (np.abs(b) + 1e-6)) < 1e-7, name
 
-    # FE internal tower-base moment: spectrum peak within a few % (the
-    # stiffness differencing amplifies the response deltas off-peak)
+    # mooring tension spectra track the golden closely too
+    a = np.asarray(metrics["Tmoor_PSD"])
+    b = np.asarray(tm["Tmoor_PSD"])
+    assert np.max(np.abs(a - b) / (np.abs(b) + np.max(np.abs(b)) * 1e-9)) < 5e-3
+
+    # FE internal tower-base moment: the MOTIONS are golden (above), so
+    # the remaining few-% deviation lives in the internal-load recovery
+    # (stiffness differencing) — tracked separately
     a = np.asarray(metrics["Mbase_PSD"])
     b = np.asarray(tm["Mbase_PSD"])
     assert abs(a.max() - b.max()) / b.max() < 0.05

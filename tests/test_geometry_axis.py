@@ -1,0 +1,149 @@
+"""Traced geometry design axis (VERDICT r2 #2).
+
+Validates that the in-trace geometry parameterisation
+(:mod:`raft_tpu.structure.members_traced`) reproduces EXACTLY what a
+Python rebuild of the design with scaled member diameters/thicknesses/
+ballast/mooring would produce (the build-time/trace-time split of
+SURVEY §7.1), and that response metrics are differentiable wrt the
+geometry parameters (matching finite differences).
+
+Reference touchpoints: parametersweep.py:56-100 (geometry DoE),
+omdao_raft.py:26-343 (WEIS design variables member_d/member_t/ballast/
+mooring), raft_member.py getInertia :412-541 + caps :659-823.
+"""
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+from tests.conftest import ref_data
+
+import raft_tpu
+from raft_tpu.api import make_full_evaluator
+
+PATH = ref_data("VolturnUS-S.yaml")
+
+CASE = dict(wind_speed=10.0, Hs=6.0, Tp=12.0, beta_deg=20.0, TI=0.1)
+
+D_S, T_S, F_S, L_S = 1.07, 0.92, 1.10, 1.02
+
+
+@pytest.fixture(scope="module")
+def model():
+    import os
+
+    if not os.path.exists(PATH):
+        pytest.skip("reference data unavailable")
+    return raft_tpu.Model(PATH)
+
+
+def _scaled_design(design):
+    """Rebuild the design dict with every member's d/t scaled and the
+    mooring line lengths scaled — the ground truth the traced geometry
+    axis must match."""
+    d2 = copy.deepcopy(design)
+    for mi in d2["platform"]["members"]:
+        mi["d"] = (np.asarray(mi["d"], dtype=float) * D_S).tolist()
+        mi["t"] = (np.asarray(mi["t"], dtype=float) * T_S).tolist()
+        if "l_fill" in mi:
+            mi["l_fill"] = (np.asarray(mi["l_fill"], dtype=float) * F_S).tolist()
+        if "cap_d_in" in mi:
+            # hole diameters follow the member scaling so the traced
+            # twin (which scales d only) is compared consistently: the
+            # traced path keeps cap_d_in fixed, so scale it here too? No:
+            # the traced path treats cap_d_in as static — leave as is.
+            pass
+    tower = d2["turbine"]["tower"]
+    towers = tower if isinstance(tower, list) else [tower]
+    for mi in towers:
+        mi["d"] = (np.asarray(mi["d"], dtype=float) * D_S).tolist()
+        mi["t"] = (np.asarray(mi["t"], dtype=float) * T_S).tolist()
+    for line in d2["mooring"]["lines"]:
+        line["length"] = float(line["length"]) * L_S
+    return d2
+
+
+@pytest.mark.slow
+def test_geometry_identity(model):
+    """all-ones geometry params == the baked-constant evaluator."""
+    ev0 = make_full_evaluator(model)
+    evg = make_full_evaluator(model, geometry=True)
+    out0 = jax.jit(ev0)(CASE)
+    outg = jax.jit(evg)(dict(CASE, geom={}))
+    assert_allclose(np.asarray(outg["PSD"]), np.asarray(out0["PSD"]),
+                    rtol=1e-9, atol=1e-12)
+    assert_allclose(np.asarray(outg["X0"]), np.asarray(out0["X0"]),
+                    rtol=1e-9, atol=1e-12)
+
+
+@pytest.mark.slow
+def test_geometry_matches_rebuild(model):
+    """Traced geometry scaling == Python rebuild of the scaled design.
+
+    This is the core build-time/trace-time split guarantee: the traced
+    member-element twin reproduces the numpy build path exactly, so a
+    geometry DoE can run through ONE compiled evaluator."""
+    evg = make_full_evaluator(model, geometry=True)
+    geom = dict(d_scale=D_S, t_scale=T_S, fill_scale=F_S, L_moor_scale=L_S)
+    outg = jax.jit(evg)(dict(CASE, geom=geom))
+
+    model2 = raft_tpu.Model(_scaled_design(model.design))
+    ev2 = make_full_evaluator(model2)
+    out2 = jax.jit(ev2)(CASE)
+
+    assert_allclose(np.asarray(outg["X0"]), np.asarray(out2["X0"]),
+                    rtol=1e-7, atol=1e-10)
+    psd_g = np.asarray(outg["PSD"])
+    psd_2 = np.asarray(out2["PSD"])
+    assert np.max(np.abs(psd_g - psd_2)) / (np.max(np.abs(psd_2)) + 1e-30) < 1e-7
+
+
+def test_geometry_statics_elements_match_rebuild(model):
+    """Element-level check: traced inertia elements at scaled d/t equal
+    the numpy build of the scaled member."""
+    from raft_tpu.structure.members import build_member
+    from raft_tpu.structure.members_traced import traced_inertia_elements
+
+    mi = dict(model.design["platform"]["members"][0])
+    mem0 = build_member(mi, heading=0.0)
+    mi2 = dict(mi)
+    mi2["d"] = (np.asarray(mi["d"], dtype=float) * D_S).tolist()
+    mi2["t"] = (np.asarray(mi["t"], dtype=float) * T_S).tolist()
+    if "l_fill" in mi2:
+        mi2["l_fill"] = (np.asarray(mi2["l_fill"], dtype=float) * F_S).tolist()
+    mem2 = build_member(mi2, heading=0.0)
+
+    lf = jnp.asarray(mem0.l_fill) * (F_S if "l_fill" in mi else 1.0)
+    em, es, ex, ey, ez, mshell, mfill = traced_inertia_elements(
+        mem0, jnp.asarray(mem0.d) * D_S, jnp.asarray(mem0.t) * T_S,
+        lf, jnp.asarray(mem0.rho_fill))
+    assert_allclose(np.asarray(em), mem2.elem_mass, rtol=1e-9, atol=1e-9)
+    assert_allclose(np.asarray(es), mem2.elem_s, rtol=1e-9, atol=1e-9)
+    assert_allclose(np.asarray(ex), mem2.elem_Ixx, rtol=1e-9, atol=1e-6)
+    assert_allclose(np.asarray(ey), mem2.elem_Iyy, rtol=1e-9, atol=1e-6)
+    assert_allclose(np.asarray(ez), mem2.elem_Izz, rtol=1e-9, atol=1e-6)
+    assert_allclose(float(mshell), mem2.mshell, rtol=1e-9)
+
+
+@pytest.mark.slow
+def test_geometry_gradient_matches_fd(model):
+    """jax.grad of a response metric wrt the member-diameter scale
+    matches central finite differences (the optimization contract of
+    the geometry axis)."""
+    evg = make_full_evaluator(model, geometry=True)
+
+    def metric(ds):
+        out = evg(dict(CASE, geom=dict(d_scale=ds)))
+        # pitch RMS-like scalar from the PSD
+        return jnp.sqrt(jnp.sum(out["PSD"][4]))
+
+    g = float(jax.jit(jax.grad(metric))(1.0))
+    h = 1e-4
+    m_p = float(jax.jit(metric)(1.0 + h))
+    m_m = float(jax.jit(metric)(1.0 - h))
+    fd = (m_p - m_m) / (2 * h)
+    assert abs(g - fd) / (abs(fd) + 1e-12) < 5e-3, (g, fd)
