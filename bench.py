@@ -56,7 +56,23 @@ def build():
     design["settings"]["max_freq"] = 0.2
     model = raft_tpu.Model(design)
     assert model.nw == 100
-    return model, make_full_evaluator(model)
+    # geometry=True: every design in the sweep is a DISTINCT geometry
+    # (member d/t, ballast fill, mooring length — the WEIS design
+    # variables, parametersweep.py:56-100) through one compilation
+    return model, make_full_evaluator(model, geometry=True)
+
+
+def sample_geometry(B, seed=0):
+    """B distinct design-geometry parameter sets, parametersweep-style
+    ranges (+/-8% member diameter/thickness, +/-10% ballast fill,
+    +/-3% mooring length)."""
+    rng = np.random.default_rng(seed)
+    return np.stack([
+        rng.uniform(0.92, 1.08, B),   # d_scale
+        rng.uniform(0.92, 1.08, B),   # t_scale
+        rng.uniform(0.90, 1.10, B),   # fill_scale
+        rng.uniform(0.97, 1.03, B),   # L_moor_scale
+    ], axis=1)
 
 
 # ---------------------------------------------------- NumPy baseline: aero
@@ -474,27 +490,87 @@ def main():
     n_cases = len(CASES)
     arr = np.array(CASES)
 
-    def eval_case(ws, wh, ti, hs, tp, bd):
-        return evaluate(dict(wind_speed=ws, wind_heading_deg=wh, TI=ti,
-                             Hs=hs, Tp=tp, beta_deg=bd))["PSD"]
+    case_cols = jnp.asarray(arr, dtype=jnp.float32)   # (12, 6) case table
 
-    fn = jax.jit(jax.vmap(eval_case))
+    def design_eval(g4, key="PSD"):
+        """One FULL design evaluation: the geometry stage once, then the
+        12-case table through the traced chain (inner vmap)."""
+        gc = evaluate.geometry_constants(dict(
+            d_scale=g4[0], t_scale=g4[1], fill_scale=g4[2],
+            L_moor_scale=g4[3]))
 
-    # batch of B designs x 12 cases, flattened (each case independent)
+        def one_case(c6):
+            return evaluate(dict(
+                wind_speed=c6[0], wind_heading_deg=c6[1], TI=c6[2],
+                Hs=c6[3], Tp=c6[4], beta_deg=c6[5], geom_const=gc))[key]
+
+        return jax.vmap(one_case)(case_cols)
+
+    def eval_case(g4, key="PSD"):
+        return design_eval(g4, key=key)
+
+    # batch of B DISTINCT design geometries x the 12-case table
     B = int(os.environ.get("RAFT_TPU_BENCH_DESIGNS", "16"))
     reps = int(os.environ.get("RAFT_TPU_BENCH_REPS", "3"))
-    tiled = np.tile(arr, (B, 1))
-    args = [jnp.asarray(tiled[:, j], dtype=jnp.float32) for j in range(6)]
-    jax.block_until_ready(fn(*args))  # compile
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        jax.block_until_ready(fn(*args))
-    dt = (time.perf_counter() - t0) / reps
+    args = [jnp.asarray(sample_geometry(B), dtype=jnp.float32)]  # (B, 4)
+
+    def timed(f, *a):
+        jax.block_until_ready(f(*a))  # compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(f(*a))
+        return (time.perf_counter() - t0) / reps
+
+    fn = jax.jit(jax.vmap(eval_case))
+    t_compile0 = time.perf_counter()
+    lowered = fn.lower(*args)
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t_compile0
+
+    dt = timed(fn, *args)
     design_evals_per_sec = B / dt
 
-    # --- NumPy baseline: serial full-case evaluations, extrapolated to
-    # the 12-case design evaluation
-    n_base = int(os.environ.get("RAFT_TPU_BENCH_NBASE", "3"))
+    # stage attribution by dead-code elimination: jitting a function
+    # that returns only (a scalar reduction of) an intermediate lets XLA
+    # prune everything downstream of it, so the timing isolates the
+    # pipeline prefix without output-transfer skew.  Each stage variant
+    # is a separate compilation (~minutes); skip when the compile budget
+    # is exhausted so the driver's bench run cannot time out.
+    # stage jits are two more multi-minute compilations — opt-in so the
+    # driver's headline run stays fast; measured numbers live in
+    # BREAKDOWN_r03.json / README
+    t_stat = t_dyn = None
+    budget = float(os.environ.get("RAFT_TPU_BENCH_STAGE_BUDGET_S", "200"))
+    if os.environ.get("RAFT_TPU_BENCH_BREAKDOWN", "0") != "0" \
+            and t_compile < budget:
+        fn_x0 = jax.jit(jax.vmap(
+            lambda *a: jnp.sum(jnp.abs(eval_case(*a, key="X0")))))
+        fn_z = jax.jit(jax.vmap(
+            lambda *a: jnp.sum(jnp.abs(eval_case(*a, key="Z")))))
+        t_stat = timed(fn_x0, *args)  # geometry + statics + aero + equilibrium
+        t_dyn = timed(fn_z, *args)    # + excitation + drag-linearised solve
+
+    # achieved FLOP rate from XLA's own cost model + an MFU estimate
+    # against the env-provided peak (default 90 TF/s f32-class; set
+    # RAFT_TPU_PEAK_TFLOPS for the actual part)
+    try:
+        flops = float(compiled.cost_analysis()["flops"])
+    except Exception:
+        flops = float("nan")
+    peak_tf = float(os.environ.get("RAFT_TPU_PEAK_TFLOPS", "90"))
+    tflops_achieved = flops / dt / 1e12
+    device_kind = jax.devices()[0].device_kind
+
+    # optional profiler capture (point RAFT_TPU_PROFILE at a directory
+    # and open the trace in TensorBoard / Perfetto)
+    prof_dir = os.environ.get("RAFT_TPU_PROFILE")
+    if prof_dir:
+        with jax.profiler.trace(prof_dir):
+            jax.block_until_ready(fn(*args))
+
+    # --- NumPy baseline: serial evaluation of ALL 12 cases (one full
+    # design evaluation), reference-style loops
+    n_base = int(os.environ.get("RAFT_TPU_BENCH_NBASE", str(n_cases)))
     cases = [dict(wind_speed=c[0], wind_heading=c[1], turbulence=c[2],
                   wave_height=c[3], wave_period=c[4], wave_heading=c[5])
              for c in CASES]
@@ -505,10 +581,23 @@ def main():
     base_design_evals_per_sec = 1.0 / (n_cases * base_case_dt)
 
     print(json.dumps({
-        "metric": "design-evals/sec/chip (VolturnUS-S, 100w x 12 cases, operating turbine)",
+        "metric": "design-evals/sec/chip (VolturnUS-S geometry DoE, 100w x 12 cases, operating turbine)",
         "value": round(design_evals_per_sec, 3),
         "unit": "design-evals/s",
         "vs_baseline": round(design_evals_per_sec / base_design_evals_per_sec, 2),
+        "breakdown": {
+            "compile_s": round(t_compile, 2),
+            "statics_equilibrium_s": round(t_stat, 4) if t_stat else None,
+            "drag_linearised_solve_s": round(t_dyn - t_stat, 4) if t_dyn else None,
+            "response_psd_s": round(dt - t_dyn, 4) if t_dyn else None,
+            "batch_designs": B,
+            "distinct_geometries": True,
+            "xla_flops_per_batch": flops,
+            "tflops_achieved": round(tflops_achieved, 4),
+            "mfu_vs_peak": round(tflops_achieved / peak_tf, 6),
+            "peak_tflops_assumed": peak_tf,
+            "device_kind": device_kind,
+        },
     }))
 
 

@@ -289,6 +289,36 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
     from raft_tpu.models.statics_solve import make_tolerances
     tol_vec, caps, refs = make_tolerances([fs])
 
+    def geometry_constants(geom):
+        """Per-design geometry stage: traced member geometry -> statics
+        matrices + zero-pose hydro constants + scaled strips/mooring.
+        Call once per design and feed the result to ``evaluate`` as
+        ``case["geom_const"]`` to amortise over a case table (the
+        geometry work is case-independent)."""
+        from raft_tpu.models.hydro import add_rotor_added_mass
+        from raft_tpu.structure.members_traced import apply_geometry
+
+        fs2, ss_t = apply_geometry(fs, ss, geom, k=k)
+        stat_t = calc_statics(fs2)
+        hc0_t = morison.hydro_constants(fs2, ss_t, jnp.eye(3), r0_nodes, Tn0)
+        A_hydro_t = add_rotor_added_mass(hc0_t["A_hydro"], fs, Tn0)
+        ms_t = ms
+        if ms is not None:
+            ms_t = dataclasses.replace(
+                ms,
+                L=jnp.asarray(ms.L) * geom.get("L_moor_scale", 1.0),
+                EA=jnp.asarray(ms.EA) * geom.get("EA_moor_scale", 1.0),
+            )
+        return dict(
+            ss=ss_t, ms=ms_t,
+            K_h=stat_t["C_struc"] + stat_t["C_hydro"],
+            C_elast=stat_t["C_elast"],
+            F_und=stat_t["W_struc"] + stat_t["W_hydro"] + stat_t["f0_additional"],
+            M_struc=stat_t["M_struc"],
+            A_hydro=A_hydro_t,
+            hc0=dict(hc0_t, A_hydro=A_hydro_t),
+        )
+
     def evaluate(case):
         wind_speed = case.get("wind_speed", 0.0)
         wind_heading = case.get("wind_heading_deg", 0.0)
@@ -307,27 +337,12 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
         K_h_t, C_elast_t, F_und_t = K_h, C_elast, F_und
         M_struc_t, A_hydro_t, hc0_t = M_struc, A_hydro, hc0
         if geometry:
-            from raft_tpu.structure.members_traced import apply_geometry
-
-            geom = case.get("geom", {})
-            fs2, ss_t = apply_geometry(fs, ss, geom, k=k)
-            stat_t = calc_statics(fs2)
-            K_h_t = stat_t["C_struc"] + stat_t["C_hydro"]
-            C_elast_t = stat_t["C_elast"]
-            F_und_t = stat_t["W_struc"] + stat_t["W_hydro"] + stat_t["f0_additional"]
-            M_struc_t = stat_t["M_struc"]
-            hc0_t = morison.hydro_constants(
-                fs2, ss_t, jnp.eye(3), r0_nodes, Tn0)
-            from raft_tpu.models.hydro import add_rotor_added_mass
-
-            A_hydro_t = add_rotor_added_mass(hc0_t["A_hydro"], fs, Tn0)
-            hc0_t = dict(hc0_t, A_hydro=A_hydro_t)
-            if ms is not None:
-                ms_t = dataclasses.replace(
-                    ms,
-                    L=jnp.asarray(ms.L) * geom.get("L_moor_scale", 1.0),
-                    EA=jnp.asarray(ms.EA) * geom.get("EA_moor_scale", 1.0),
-                )
+            gc = case.get("geom_const")
+            if gc is None:
+                gc = geometry_constants(case.get("geom", {}))
+            ss_t, ms_t = gc["ss"], gc["ms"]
+            K_h_t, C_elast_t, F_und_t = gc["K_h"], gc["C_elast"], gc["F_und"]
+            M_struc_t, A_hydro_t, hc0_t = gc["M_struc"], gc["A_hydro"], gc["hc0"]
 
         # ---- aero-servo constants about the rotor nodes (zero-pose Tn,
         # matching the reference's calcTurbineConstants-at-case-start)
@@ -458,6 +473,336 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
             drag_resid=dyn_diag["drag_resid"],
             drag_converged=dyn_diag["drag_converged"],
         )
+
+    evaluate.geometry_constants = geometry_constants
+    return evaluate
+
+
+def make_farm_evaluator(model, nWaves=1, turb_static=None):
+    """FULL-PHYSICS traced case evaluator for a multi-FOWT array: the
+    coupled chain of Model.solveStatics/solveDynamics for farms
+    (raft_model.py:550-964, :966-1255 incl. the system assembly
+    :1164-1236) as one pure jax function — per-FOWT aero-servo
+    constants (waked per-FOWT wind speeds enter as case inputs; the
+    wake solve itself lives in :mod:`raft_tpu.physics.wake`), the
+    COUPLED static equilibrium over all platforms with shared-mooring
+    network forces, per-FOWT Morison excitation with the array phase
+    carried by each unit's absolute node positions, per-FOWT
+    drag-linearised impedances, and the block system impedance with
+    shared-mooring stiffness solved for every heading.
+
+    ``evaluate(case)`` takes
+        wind_speed — scalar or (nFOWT,) per-unit (waked) speeds
+        wind_heading_deg, TI, current_speed, current_heading_deg
+        Hs, Tp, gamma, beta_deg — (nWaves,)
+    and returns X0 (nDOF_total,), Xi (nWaves+1, nDOF_total, nw), PSD,
+    S, zeta, drag diagnostics per FOWT.
+
+    jit/vmap/shard over case and design axes exactly like the
+    single-FOWT evaluator; parity vs the orchestrated path is gated at
+    1e-9 (tests/test_farm_evaluator.py).
+    """
+    import scipy.linalg
+
+    fowts = model.fowtList
+    nFOWT = model.nFOWT
+    assert nFOWT >= 1
+    for fs_i in fowts:
+        assert fs_i.is_single_body, "farm evaluator covers rigid units"
+    assert all(b is None for b in model.bem_list), \
+        "potential-flow farms run through the orchestrated path for now"
+    assert model.qtf is None, "external QTFs unsupported in the farm trace"
+
+    w = jnp.asarray(model.w)
+    k = jnp.asarray(model.k)
+    dw = model.w[1] - model.w[0]
+    nw = model.nw
+    offs = model.dof_offsets
+    nDOF_T = model.nDOF
+
+    stats = [model.statics(i) for i in range(nFOWT)]
+    hydro = model.hydro
+    K_h = scipy.linalg.block_diag(
+        *[np.asarray(s["C_struc"] + s["C_hydro"]) for s in stats])
+    C_elast = scipy.linalg.block_diag(
+        *[np.asarray(s["C_elast"]) for s in stats])
+    F_und = np.concatenate(
+        [np.asarray(s["W_struc"] + s["W_hydro"] + s["f0_additional"])
+         for s in stats])
+    M_structs = [np.asarray(s["M_struc"]) for s in stats]
+    A_hydros = [np.asarray(hydro[i].hc0["A_hydro"]) for i in range(nFOWT)]
+    hc0s = [hydro[i].hc0 for i in range(nFOWT)]
+    sss = [hydro[i].strips for i in range(nFOWT)]
+    Tn0s, r0s = [], []
+    for fs_i in fowts:
+        r0_i = jnp.asarray(fs_i.node_r0, dtype=float)
+        r0s.append(r0_i)
+        Tn0s.append(node_T(r0_i, r0_i[fs_i.root_id]))
+
+    rotor_aero = model.rotor_aero if fowts[0].nrotors else []
+    from raft_tpu.physics.aero import calc_aero_traced, operating_point
+
+    from raft_tpu.models.statics_solve import make_tolerances
+    tol_vec, caps, refs = make_tolerances(fowts)
+    force, stiff = model._mooring_closures()  # pure jnp closures
+
+    def evaluate(case):
+        wind_speed = jnp.asarray(case.get("wind_speed", 0.0)) * jnp.ones(nFOWT)
+        wind_heading = case.get("wind_heading_deg", 0.0)
+        TI = case.get("TI", 0.0)
+        yaw_cmd = jnp.deg2rad(case.get("yaw_misalign_deg", 0.0))
+        cur_speed = case.get("current_speed", 0.0)
+        cur_heading = case.get("current_heading_deg", 0.0)
+        Hs = jnp.atleast_1d(jnp.asarray(case["Hs"], dtype=float))
+        Tp = jnp.atleast_1d(jnp.asarray(case.get("Tp", 10.0), dtype=float))
+        gamma = jnp.atleast_1d(jnp.asarray(case.get("gamma", 0.0)) * jnp.ones(nWaves))
+        beta_deg = jnp.atleast_1d(jnp.asarray(case.get("beta_deg", 0.0)) * jnp.ones(nWaves))
+        beta = jnp.deg2rad(beta_deg)
+
+        # ---- per-FOWT aero-servo constants + current loads
+        f_env_parts, aero = [], []
+        for i, fs_i in enumerate(fowts):
+            nDOF = fs_i.nDOF
+            f0_i = jnp.zeros(nDOF)
+            A_i = jnp.zeros((nDOF, nDOF, nw))
+            B_i = jnp.zeros((nDOF, nDOF, nw))
+            Bg_i = jnp.zeros((nDOF, nDOF))
+            for ir, rot in enumerate(rotor_aero):
+                rprops = fs_i.rotors[ir]
+                if rprops.aeroServoMod <= 0:
+                    continue
+                current = rprops.Zhub < 0
+                speed = cur_speed if current else wind_speed[i]
+                heading = jnp.deg2rad(cur_heading if current else wind_heading)
+                on = speed > 0
+                speed_safe = jnp.maximum(speed, 0.1)
+                f0, f6, a6, b6, Bg, qv = calc_aero_traced(
+                    rot, rprops, w, speed_safe, heading, TI,
+                    yaw_command_rad=yaw_cmd,
+                    turb_static=turb_static or ("NTM", 50.0))
+                Tn_n = Tn0s[i][int(fs_i.rotor_node[ir])]
+                f0_i = f0_i + on * (Tn_n.T @ f0)
+                A_i = A_i + on * jnp.einsum("ia,ijw,jb->abw", Tn_n, a6, Tn_n)
+                B_i = B_i + on * jnp.einsum("ia,ijw,jb->abw", Tn_n, b6, Tn_n)
+                Bg_i = Bg_i + on * (Tn_n.T @ Bg @ Tn_n)
+            F_cur_i = morison.current_loads(
+                fs_i, sss[i], hc0s[i], cur_speed, cur_heading,
+                min([r.Zhub for r in fs_i.rotors if r.Zhub < 0], default=0.0),
+                Tn0s[i], r0s[i])
+            f_env_parts.append(F_cur_i + f0_i)
+            aero.append((A_i, B_i, Bg_i))
+
+        # ---- coupled equilibrium (shared mooring through the closures)
+        from raft_tpu.models.statics_solve import solve_equilibrium_general
+        F_env = jnp.concatenate(f_env_parts)
+        X0, _ = solve_equilibrium_general(
+            jnp.asarray(K_h), jnp.asarray(F_und), F_env, force, stiff,
+            tol_vec, caps, refs, C_elast=jnp.asarray(C_elast))
+
+        # ---- sea states (shared across units; phases via positions)
+        S = jax.vmap(lambda h, t, g_: wv.jonswap(w, h, t, gamma=g_))(Hs, Tp, gamma)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+
+        # ---- per-FOWT excitation + drag-linearised impedance
+        Z_blocks, F_waves, resids = [], [[] for _ in range(nWaves)], []
+        for i, fs_i in enumerate(fowts):
+            nDOF = fs_i.nDOF
+            X0_i = X0[offs[i]:offs[i + 1]]
+            r_nodes, R_ptfm, r_root = platform_kinematics(fs_i, X0_i)
+            Tn = node_T(r_nodes, r_root)
+            r, q, p1, p2 = morison.strip_frames(sss[i], R_ptfm, r_nodes)
+            sub = r[:, 2] < 0
+            hc = dict(hc0s[i], r=r, q=q, p1=p1, p2=p2, sub=sub,
+                      active=sub & jnp.asarray(sss[i].active))
+            exc = morison.hydro_excitation(
+                fs_i, sss[i], hc, zeta, beta, w, k, Tn, r_nodes)
+            A_i, B_i, Bg_i = aero[i]
+            C_moor = jnp.zeros((nDOF, nDOF))
+            if model.ms_list[i] is not None:
+                C_moor = C_moor.at[:6, :6].add(
+                    mooring_stiffness(model.ms_list[i], X0_i[:6]))
+            M_lin = A_i + (jnp.asarray(M_structs[i])
+                           + jnp.asarray(A_hydros[i]))[:, :, None]
+            B_lin = B_i + Bg_i[:, :, None]
+            C_lin = (jnp.asarray(K_h[offs[i]:offs[i + 1], offs[i]:offs[i + 1]])
+                     + C_moor
+                     + jnp.asarray(C_elast[offs[i]:offs[i + 1],
+                                           offs[i]:offs[i + 1]]))
+            F_lin = exc["F_hydro_iner"][0]
+            Z_i, _, Bmat, diag_i = solve_dynamics_fowt(
+                fs_i, sss[i], hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
+                w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart)
+            Z_blocks.append(Z_i)
+            resids.append(diag_i["drag_resid"])
+            for ih in range(nWaves):
+                F_drag = morison.drag_excitation(
+                    fs_i, sss[i], hc, Bmat, exc["u"][ih], Tn, r_nodes)
+                F_waves[ih].append(exc["F_hydro_iner"][ih] + F_drag)
+
+        # ---- system impedance: block FOWT impedances + shared-mooring
+        # stiffness (raft_model.py:1164-1182)
+        Z_sys = jnp.zeros((nw, nDOF_T, nDOF_T), dtype=complex)
+        for i in range(nFOWT):
+            Z_sys = Z_sys.at[:, offs[i]:offs[i + 1], offs[i]:offs[i + 1]].add(
+                Z_blocks[i])
+        if model.ms_array is not None:
+            r6_all = jnp.stack(
+                [X0[offs[i]:offs[i] + 6] for i in range(nFOWT)])
+            Ka = model.ms_array.stiffness(r6_all)
+            for i in range(nFOWT):
+                for j in range(nFOWT):
+                    Z_sys = Z_sys.at[:, offs[i]:offs[i] + 6,
+                                     offs[j]:offs[j] + 6].add(
+                        Ka[6 * i:6 * i + 6, 6 * j:6 * j + 6][None])
+
+        F_sys = jnp.stack([jnp.concatenate(Fw, axis=0) for Fw in F_waves])
+        Xi = system_response(Z_sys, F_sys)
+        Xi = jnp.concatenate(
+            [Xi, jnp.zeros((1, nDOF_T, nw), dtype=complex)])
+        PSD = jnp.sum(0.5 * jnp.abs(Xi) ** 2 / dw, axis=0)
+        return dict(X0=X0, Xi=Xi, PSD=PSD, S=S, zeta=zeta,
+                    drag_resid=jnp.stack(resids))
+
+    return evaluate
+
+
+def make_flexible_evaluator(model, nWaves=1, turb_static=None):
+    """FULL-PHYSICS traced case evaluator for a flexible/multibody
+    single-FOWT model (reduced N-DOF structures, e.g. the 150-DOF
+    VolturnUS-S-flexible): the displaced-pose node kinematics and the
+    position-dependent transformation matrix T run in-trace through
+    :class:`raft_tpu.structure.topology_traced.TracedTopology` (static
+    traversal schedules, traced values), so the whole chain —
+    equilibrium, nonlinear mean-offset kinematics, N-DOF Morison
+    excitation, drag-linearised (nw, N, N) impedance solves — is one
+    pure jax function of the case parameters (VERDICT r2 #3; matches
+    Model.solveDynamics for flexible FOWTs, raft_model.py:966-1255 with
+    setNodesPosition/reduceDOF, raft_fowt.py:553-780).
+
+    Parity vs the orchestrated path is gated at 1e-9
+    (tests/test_flexible_evaluator.py).
+    """
+    fs = model.fowtList[0]
+    assert model.nFOWT == 1, "single-FOWT flexible evaluator"
+    assert not fs.is_single_body, \
+        "rigid FOWTs use make_full_evaluator (this is the N-DOF path)"
+    assert all(b is None for b in model.bem_list)
+    assert model.qtf is None
+    from raft_tpu.structure.topology_traced import TracedTopology
+
+    tt = TracedTopology(fs)
+    ms = model.ms
+    fh = model.hydro[0]
+    ss = fh.strips
+    w = jnp.asarray(model.w)
+    k = jnp.asarray(model.k)
+    dw = model.w[1] - model.w[0]
+    nw = model.nw
+    nDOF = fs.nDOF
+
+    stat = model.statics()
+    K_h = np.asarray(stat["C_struc"] + stat["C_hydro"])
+    C_elast = np.asarray(stat["C_elast"])
+    F_und = np.asarray(stat["W_struc"] + stat["W_hydro"] + stat["f0_additional"])
+    M_struc = np.asarray(stat["M_struc"])
+    A_hydro = np.asarray(fh.hc0["A_hydro"])
+    hc0 = fh.hc0
+    Tn0 = jnp.asarray(fs.T).reshape(fs.n_nodes, 6, nDOF)
+
+    rotor_aero = model.rotor_aero if fs.nrotors else []
+    from raft_tpu.physics.aero import calc_aero_traced
+
+    from raft_tpu.models.statics_solve import make_tolerances, \
+        single_ms_closures, solve_equilibrium_general
+    tol_vec, caps, refs = make_tolerances([fs])
+    force, stiff = single_ms_closures(ms, nDOF)
+
+    def evaluate(case):
+        wind_speed = case.get("wind_speed", 0.0)
+        wind_heading = case.get("wind_heading_deg", 0.0)
+        TI = case.get("TI", 0.0)
+        yaw_cmd = jnp.deg2rad(case.get("yaw_misalign_deg", 0.0))
+        cur_speed = case.get("current_speed", 0.0)
+        cur_heading = case.get("current_heading_deg", 0.0)
+        Hs = jnp.atleast_1d(jnp.asarray(case["Hs"], dtype=float))
+        Tp = jnp.atleast_1d(jnp.asarray(case.get("Tp", 10.0), dtype=float))
+        gamma = jnp.atleast_1d(jnp.asarray(case.get("gamma", 0.0)) * jnp.ones(nWaves))
+        beta_deg = jnp.atleast_1d(jnp.asarray(case.get("beta_deg", 0.0)) * jnp.ones(nWaves))
+        beta = jnp.deg2rad(beta_deg)
+
+        # ---- aero-servo constants (zero-pose rotor-node T rows, the
+        # reference's calcTurbineConstants-at-case-start)
+        f_aero0 = jnp.zeros(nDOF)
+        A_aero = jnp.zeros((nDOF, nDOF, nw))
+        B_aero = jnp.zeros((nDOF, nDOF, nw))
+        B_gyro = jnp.zeros((nDOF, nDOF))
+        for ir, rot in enumerate(rotor_aero):
+            rprops = fs.rotors[ir]
+            if rprops.aeroServoMod <= 0:
+                continue
+            current = rprops.Zhub < 0
+            speed = cur_speed if current else wind_speed
+            heading = jnp.deg2rad(cur_heading if current else wind_heading)
+            on = speed > 0
+            speed_safe = jnp.maximum(speed, 0.1)
+            f0, f6, a6, b6, Bg, qv = calc_aero_traced(
+                rot, rprops, w, speed_safe, heading, TI,
+                yaw_command_rad=yaw_cmd,
+                turb_static=turb_static or ("NTM", 50.0))
+            Tn_n = Tn0[int(fs.rotor_node[ir])]
+            f_aero0 = f_aero0 + on * (Tn_n.T @ f0)
+            A_aero = A_aero + on * jnp.einsum("ia,ijw,jb->abw", Tn_n, a6, Tn_n)
+            B_aero = B_aero + on * jnp.einsum("ia,ijw,jb->abw", Tn_n, b6, Tn_n)
+            B_gyro = B_gyro + on * (Tn_n.T @ Bg @ Tn_n)
+
+        F_current = morison.current_loads(
+            fs, ss, hc0, cur_speed, cur_heading,
+            min([r.Zhub for r in fs.rotors if r.Zhub < 0], default=0.0),
+            Tn0, jnp.asarray(fs.node_r0))
+
+        # ---- equilibrium
+        F_env = F_current + f_aero0
+        X0, _ = solve_equilibrium_general(
+            jnp.asarray(K_h), jnp.asarray(F_und), F_env, force, stiff,
+            tol_vec, caps, refs, C_elast=jnp.asarray(C_elast))
+
+        # ---- traced displaced-pose kinematics (nonlinear rigid-link /
+        # beam-chain node displacements + position-dependent T)
+        r_nodes, node_rot, Tn = tt.kinematics(X0)
+        r, q, p1, p2 = morison.strip_frames(
+            ss, jnp.eye(3), r_nodes, node_rot=node_rot)
+        sub = r[:, 2] < 0
+        hc = dict(hc0, r=r, q=q, p1=p1, p2=p2, sub=sub,
+                  active=sub & jnp.asarray(ss.active))
+
+        # ---- excitation + drag-linearised N-DOF impedance solve
+        S = jax.vmap(lambda h, t, g_: wv.jonswap(w, h, t, gamma=g_))(Hs, Tp, gamma)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        exc = morison.hydro_excitation(fs, ss, hc, zeta, beta, w, k, Tn, r_nodes)
+
+        C_moor = jnp.zeros((nDOF, nDOF))
+        if ms is not None:
+            C_moor = C_moor.at[:6, :6].add(mooring_stiffness(ms, X0[:6]))
+        M_lin = A_aero + (jnp.asarray(M_struc) + jnp.asarray(A_hydro))[:, :, None]
+        B_lin = B_aero + B_gyro[:, :, None]
+        C_lin = jnp.asarray(K_h) + C_moor + jnp.asarray(C_elast)
+        F_lin = exc["F_hydro_iner"][0]
+
+        Z, _, Bmat, dyn_diag = solve_dynamics_fowt(
+            fs, ss, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
+            w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart)
+
+        def fwave_one(ih):
+            F_drag = morison.drag_excitation(fs, ss, hc, Bmat, exc["u"][ih],
+                                             Tn, r_nodes)
+            return exc["F_hydro_iner"][ih] + F_drag
+        F_waves = jnp.stack([fwave_one(ih) for ih in range(nWaves)])
+        Xi = system_response(Z, F_waves)
+        Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=complex)])
+        PSD = jnp.sum(0.5 * jnp.abs(Xi) ** 2 / dw, axis=0)
+        return dict(X0=X0, Xi=Xi, PSD=PSD, S=S, zeta=zeta,
+                    drag_resid=dyn_diag["drag_resid"],
+                    drag_converged=dyn_diag["drag_converged"])
 
     return evaluate
 

@@ -29,7 +29,8 @@ from raft_tpu.ops import waves as wv
 from raft_tpu.ops import waves2
 
 
-def member_qtf(mem, a_i_member, Xi, beta, w2nd, k2nd, depth, rho, g):
+def member_qtf(mem, a_i_member, Xi, beta, w2nd, k2nd, depth, rho, g,
+               pair_idx=None):
     """Upper-triangle QTF contribution of one rigid member (6 DOF about
     the PRP).  Twin of Member.calcQTF_slenderBody
     (raft_member.py:1488-1674), vmapped over frequency pairs.
@@ -37,7 +38,10 @@ def member_qtf(mem, a_i_member, Xi, beta, w2nd, k2nd, depth, rho, g):
     mem : MemberGeometry (reference pose);
     a_i_member : (ns,) signed axial areas from the hydro-constants stage;
     Xi : (6, nw2) motion RAOs at the QTF frequencies; beta [rad].
-    Returns qtf (nw2, nw2, 6) complex (upper triangle filled).
+    Returns qtf (nw2, nw2, 6) complex (upper triangle filled); with
+    ``pair_idx=(i1, i2)`` (the sharded-grid path,
+    :func:`raft_tpu.parallel.sweep.qtf_slender_sharded`) returns the
+    flat (npairs, 6) pair forces for those indices instead.
     """
     nw2 = len(w2nd)
     ns = mem.ns
@@ -48,6 +52,8 @@ def member_qtf(mem, a_i_member, Xi, beta, w2nd, k2nd, depth, rho, g):
     rA = jnp.asarray(mem.rA0)
     rB = jnp.asarray(mem.rB0)
     if mem.rA0[2] > 0 and mem.rB0[2] > 0:
+        if pair_idx is not None:
+            return jnp.zeros((len(pair_idx[0]), 6), dtype=complex)
         return jnp.zeros((nw2, nw2, 6), dtype=complex)
 
     q = jnp.asarray(mem.q0)
@@ -241,6 +247,9 @@ def member_qtf(mem, a_i_member, Xi, beta, w2nd, k2nd, depth, rho, g):
             F = F + jnp.concatenate([f_eta, jnp.cross(r_int, f_eta)])
         return F
 
+    if pair_idx is not None:
+        return jax.vmap(pair)(jnp.asarray(pair_idx[0]),
+                              jnp.asarray(pair_idx[1]))
     Fpairs = jax.vmap(pair)(jnp.asarray(idx1), jnp.asarray(idx2))
     qtf = jnp.zeros((nw2, nw2, 6), dtype=complex)
     qtf = qtf.at[idx1, idx2, :].set(Fpairs)

@@ -1,0 +1,256 @@
+"""Traced (jax) twin of the topology kinematics for flexible/multibody
+FOWTs, so the 150-DOF displaced-pose chain can live inside one jit.
+
+The numpy path (:mod:`raft_tpu.structure.topology`) computes
+
+* ``displacements`` — nonlinear mean node displacements (rigid links
+  rotate exactly, ball joints keep their own linear rotation, beam
+  chains get the end node's nonlinear-minus-linear correction;
+  setNodesPosition, raft_fowt.py:669-752), and
+* ``reduce(positions)`` — the structure transformation matrix T whose
+  rigid-link blocks depend on the current node positions
+  (reduceDOF/attachToNode, raft_fowt.py:553-624, raft_node.py:79-159)
+
+by breadth-first traversals whose *structure* (visit order, joint
+types, column bookkeeping) is fully determined at build time.  Here the
+traversals are recorded once into static schedules and replayed with
+``jax.numpy`` values, making both maps pure traced functions of the
+reduced displacement vector.  The builder validates the replay against
+the numpy path at machine precision before returning.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.ops import transforms as tf
+
+
+class TracedTopology:
+    """Static schedules + traced replays of displacements/reduce."""
+
+    def __init__(self, fs):
+        topo = fs.topology
+        self.n_nodes = len(topo.nodes)
+        self.nDOF = fs.nDOF
+        self.root_id = fs.root_id
+        self.T0 = np.asarray(fs.T)
+        self.node_r0 = np.asarray(fs.node_r0)
+        self._build_disp_schedule(topo)
+        self._build_reduce_schedule(topo)
+        self._validate(topo, fs)
+
+    # ------------------------------------------------ displacement map
+    def _build_disp_schedule(self, topo):
+        """Replay Topology.displacements' BFS, recording the op list."""
+        nodes = topo.nodes
+        links_by_node = {}
+        for a, b in topo._links:
+            links_by_node.setdefault(a, []).append(b)
+            links_by_node.setdefault(b, []).append(a)
+        joint_groups = {}
+        for nd in nodes:
+            if nd.joint_id is not None:
+                joint_groups.setdefault(nd.joint_id, []).append(nd.id)
+        chains_by_node = {}
+        for chain in topo._chains:
+            for nid in chain:
+                chains_by_node[nid] = chain
+
+        sched = []
+        root = nodes[self.root_id]
+        visited = {root.id}
+        queue = [root]
+        while queue:
+            node = queue.pop(0)
+            for pid in links_by_node.get(node.id, []):
+                p = nodes[pid]
+                if p.id in visited:
+                    continue
+                sched.append(("rigid", p.id, node.id,
+                              np.asarray(p.r0 - node.r0)))
+                visited.add(p.id)
+                queue.append(p)
+            if node.joint_id is not None:
+                for nid in joint_groups.get(node.joint_id, []):
+                    nn = nodes[nid]
+                    if nn.id in visited:
+                        continue
+                    sched.append(("joint", nn.id, node.id,
+                                  nn.joint_type == "ball"))
+                    visited.add(nn.id)
+                    queue.append(nn)
+            if node.end_node and node.id in chains_by_node:
+                chain_new = [nid for nid in chains_by_node[node.id]
+                             if nid not in visited]
+                if chain_new:
+                    sched.append(("chain", tuple(chain_new), node.id, None))
+                for nid in chain_new:
+                    visited.add(nid)
+                    queue.append(nodes[nid])
+        self.disp_schedule = sched
+
+    def displacements(self, Xi0):
+        """Traced twin of Topology.displacements with the build-time T
+        (the reference's one-step solver lag; see
+        Topology.self_consistent_displacements)."""
+        Xi0 = jnp.asarray(Xi0)
+        lin = (jnp.asarray(self.T0) @ Xi0).reshape(self.n_nodes, 6)
+        disp = [None] * self.n_nodes
+        disp[self.root_id] = lin[self.root_id]
+        for kind, child, parent, aux in self.disp_schedule:
+            if kind == "rigid":
+                th = lin[parent][3:]
+                R = tf.rotation_matrix(th[0], th[1], th[2])
+                dd = (R - jnp.eye(3)) @ jnp.asarray(aux)
+                disp[child] = jnp.concatenate(
+                    [disp[parent][:3] + dd, disp[parent][3:]])
+            elif kind == "joint":
+                d = disp[parent]
+                if aux:  # ball joint keeps its own linear rotation
+                    d = jnp.concatenate([d[:3], lin[child][3:]])
+                disp[child] = d
+            else:  # beam chain: linear + end-node nonlinear correction
+                dR = disp[parent] - lin[parent]
+                for nid in child:
+                    disp[nid] = lin[nid] + dR
+        # unreached nodes fall back to the linear map
+        for i in range(self.n_nodes):
+            if disp[i] is None:
+                disp[i] = lin[i]
+        return jnp.stack(disp)
+
+    # --------------------------------------------------- T(positions)
+    def _build_reduce_schedule(self, topo):
+        """Replay Topology.reduce at the reference pose, recording per
+        node: parent, op kind, the child->[parent cols (+new)] column
+        permutation, and the node's global column indices."""
+        # run the numpy reduce to land the per-node reducedDOF state
+        T_ref, reducedDOF, root_id = topo.reduce()
+        assert root_id == self.root_id
+        nodes = topo.nodes
+        glob_col = {tuple(d): i for i, d in enumerate(reducedDOF)}
+        self.node_cols = [
+            np.asarray([glob_col[tuple(d)] for d in n.reducedDOF], dtype=int)
+            for n in nodes
+        ]
+
+        links_by_node = {}
+        for a, b in topo._links:
+            links_by_node.setdefault(a, []).append(b)
+            links_by_node.setdefault(b, []).append(a)
+        joint_groups = {}
+        for nd in nodes:
+            if nd.joint_id is not None:
+                joint_groups.setdefault(nd.joint_id, []).append(nd.id)
+        chains_by_node = {}
+        for chain in topo._chains:
+            for nid in chain:
+                chains_by_node[nid] = chain
+
+        sched = []
+        visited = {root_id}
+        queue = [nodes[root_id]]
+        identity_nodes = {root_id}
+        while queue:
+            node = queue.pop(0)
+            for pid in links_by_node.get(node.id, []):
+                p = nodes[pid]
+                if p.id in visited:
+                    continue
+                sched.append(self._attach_record(nodes, p, node, "rigid_link"))
+                visited.add(p.id)
+                queue.append(p)
+            if node.joint_id is not None:
+                for nid in joint_groups.get(node.joint_id, []):
+                    nn = nodes[nid]
+                    if nn.id in visited:
+                        continue
+                    sched.append(
+                        self._attach_record(nodes, nn, node, nn.joint_type))
+                    visited.add(nn.id)
+                    queue.append(nn)
+            if node.end_node and node.id in chains_by_node:
+                for nid in chains_by_node[node.id]:
+                    if nid not in visited:
+                        visited.add(nid)
+                        identity_nodes.add(nid)
+                        queue.append(nodes[nid])
+        self.reduce_schedule = sched
+        self.identity_nodes = sorted(identity_nodes)
+
+    @staticmethod
+    def _attach_record(nodes, child, parent, jtype):
+        """Static column bookkeeping of one attach (topology.attach)."""
+        p_dofs = [tuple(d) for d in parent.reducedDOF]
+        c_dofs = [tuple(d) for d in child.reducedDOF]
+        if jtype == "rigid_link" or jtype == "cantilever":
+            # same column set; child order is the (node,dof) sort of the
+            # parent's columns
+            perm = np.asarray([p_dofs.index(d) for d in c_dofs], dtype=int)
+            return (jtype, child.id, parent.id, perm, None)
+        elif jtype in ("ball", "universal"):
+            # columns = kept subset of [parent cols..., 3 new rot cols]
+            ext = p_dofs + [(child.id, i) for i in range(3, 6)]
+            sel = np.asarray([ext.index(d) for d in c_dofs], dtype=int)
+            new_rows = np.asarray(
+                [c_dofs.index((child.id, i)) if (child.id, i) in c_dofs
+                 else -1 for i in range(3, 6)], dtype=int)
+            return (jtype, child.id, parent.id, sel, new_rows)
+        raise ValueError(f"joint type {jtype!r} not supported")
+
+    def reduce_T(self, positions):
+        """Traced T at the given node positions (n_nodes, 3)."""
+        r = jnp.asarray(positions)
+        Taux = {}
+        for nid in self.identity_nodes:
+            Taux[nid] = jnp.eye(6)
+        for kind, child, parent, sel, new_rows in self.reduce_schedule:
+            Tp = Taux[parent]
+            if kind == "rigid_link":
+                H = tf.skew(r[child] - r[parent])  # == topology._getH
+                Tc = jnp.concatenate(
+                    [Tp[:3] + H @ Tp[3:6], Tp[3:6]], axis=0)
+                Taux[child] = Tc[:, sel]
+            elif kind == "cantilever":
+                Taux[child] = Tp[:, sel]
+            else:  # ball/universal
+                ncols_p = Tp.shape[1]
+                ext = jnp.concatenate([Tp, jnp.zeros((6, 3))], axis=1)
+                ext = ext.at[3:6, :].set(0.0)
+                for j, row in enumerate(range(3, 6)):
+                    ext = ext.at[row, ncols_p + j].set(1.0)
+                Taux[child] = ext[:, sel]
+        T = jnp.zeros((6 * self.n_nodes, self.nDOF))
+        for nid in range(self.n_nodes):
+            T = T.at[6 * nid:6 * nid + 6,
+                     jnp.asarray(self.node_cols[nid])].set(Taux[nid])
+        return T
+
+    # ------------------------------------------------------ validation
+    def _validate(self, topo, fs, atol=1e-12):
+        rng = np.random.default_rng(0)
+        Xi0 = 1e-2 * rng.standard_normal(self.nDOF)
+        disp_np = topo.displacements(fs.T, fs.reducedDOF, fs.root_id, Xi0)
+        disp_tr = np.asarray(self.displacements(jnp.asarray(Xi0)))
+        if not np.allclose(disp_tr, disp_np, atol=atol):
+            raise RuntimeError("traced displacement map mismatch")
+        r_np = self.node_r0 + disp_np[:, :3]
+        T_np, _, _ = topo.reduce(positions=r_np)
+        topo.reduce()  # restore reference-pose traversal state
+        T_tr = np.asarray(self.reduce_T(jnp.asarray(r_np)))
+        if not np.allclose(T_tr, T_np, atol=atol):
+            raise RuntimeError("traced reduce(T) mismatch")
+
+    # --------------------------------------------------- kinematics map
+    def kinematics(self, Xi0):
+        """(r_nodes, node_rot, Tn) at the displaced pose — the traced
+        twin of FOWTHydro._kinematics' flexible branch (one-lag
+        semantics: displacements with the build-time T, T rebuilt once
+        at the displaced positions)."""
+        disp = self.displacements(Xi0)
+        r_nodes = jnp.asarray(self.node_r0) + disp[:, :3]
+        T_disp = self.reduce_T(r_nodes)
+        Tn = T_disp.reshape(self.n_nodes, 6, self.nDOF)
+        return r_nodes, disp[:, 3:], Tn
