@@ -482,9 +482,82 @@ def _catenary_np(XF, ZF, L, w_line, EA, n_iter=60):
 
 # ------------------------------------------------------------------- main
 
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: repeated bench runs (driver
+    retries, round reruns) skip recompilation entirely."""
+    import jax
+
+    # the axon TPU plugin in this image overrides JAX_PLATFORMS at
+    # import time, so an explicit platform request (CPU testing) must go
+    # through the config, not the env var
+    platform = os.environ.get("RAFT_TPU_BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    try:
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "_jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+    except Exception:
+        pass
+
+
 def main():
+    """Driver entry: run the full geometry-DoE bench in a subprocess
+    with a deadline; if it cannot finish (e.g. an accelerator-compiler
+    blowup), fall back to the fixed-geometry configuration so the
+    driver ALWAYS receives a benchmark number (round-3 lesson: the
+    full config timed out silently and the round shipped without any
+    performance evidence)."""
+    import subprocess
+    import sys
+
+    mode = os.environ.get("RAFT_TPU_BENCH_MODE", "")
+    if mode:
+        run_mode(mode)
+        return
+
+    budget = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", "1350"))
+    t_start = time.perf_counter()
+    attempts = [("geom", 0.62), ("flat", 1.0)]
+    last_err = ""
+    for mode, share in attempts:
+        remaining = budget - (time.perf_counter() - t_start)
+        deadline = max(60.0, remaining * share)
+        env = dict(os.environ, RAFT_TPU_BENCH_MODE=mode)
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, timeout=deadline, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            last_err = f"mode={mode} exceeded {deadline:.0f}s"
+            continue
+        for line in reversed((p.stdout or "").strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+            except Exception:
+                continue
+            if not (isinstance(parsed, dict) and "metric" in parsed):
+                continue  # stray JSON-ish stdout line, not the result
+            print(line)
+            return
+        tail = (p.stderr or "").strip().splitlines()[-3:]
+        last_err = f"mode={mode} rc={p.returncode}: " + " | ".join(tail)
+    print(json.dumps({
+        "metric": "design-evals/sec/chip (VolturnUS-S, 100w x 12 cases)",
+        "value": 0.0, "unit": "design-evals/s", "vs_baseline": 0.0,
+        "error": last_err,
+    }))
+
+
+def run_mode(mode):
+    _enable_compile_cache()
     import jax
     import jax.numpy as jnp
+
+    if mode == "flat":
+        run_flat()
+        return
 
     model, evaluate = build()
     n_cases = len(CASES)
@@ -515,7 +588,7 @@ def main():
     args = [jnp.asarray(sample_geometry(B), dtype=jnp.float32)]  # (B, 4)
 
     def timed(f, *a):
-        jax.block_until_ready(f(*a))  # compile
+        jax.block_until_ready(f(*a))  # warm up (compile for jit fns)
         t0 = time.perf_counter()
         for _ in range(reps):
             jax.block_until_ready(f(*a))
@@ -527,18 +600,19 @@ def main():
     compiled = lowered.compile()
     t_compile = time.perf_counter() - t_compile0
 
-    dt = timed(fn, *args)
+    # time the compiled executable directly — calling fn(*args) would
+    # trigger a second, redundant compilation (lower().compile() does
+    # not populate the jit cache)
+    dt = timed(compiled, *args)
     design_evals_per_sec = B / dt
 
     # stage attribution by dead-code elimination: jitting a function
     # that returns only (a scalar reduction of) an intermediate lets XLA
     # prune everything downstream of it, so the timing isolates the
     # pipeline prefix without output-transfer skew.  Each stage variant
-    # is a separate compilation (~minutes); skip when the compile budget
-    # is exhausted so the driver's bench run cannot time out.
-    # stage jits are two more multi-minute compilations — opt-in so the
-    # driver's headline run stays fast; measured numbers live in
-    # BREAKDOWN_r03.json / README
+    # is a separate compilation; opt-in (RAFT_TPU_BENCH_BREAKDOWN=1,
+    # results written to BREAKDOWN.json) so the driver's headline run
+    # stays fast.
     t_stat = t_dyn = None
     budget = float(os.environ.get("RAFT_TPU_BENCH_STAGE_BUDGET_S", "200"))
     if os.environ.get("RAFT_TPU_BENCH_BREAKDOWN", "0") != "0" \
@@ -550,26 +624,57 @@ def main():
         t_stat = timed(fn_x0, *args)  # geometry + statics + aero + equilibrium
         t_dyn = timed(fn_z, *args)    # + excitation + drag-linearised solve
 
-    # achieved FLOP rate from XLA's own cost model + an MFU estimate
-    # against the env-provided peak (default 90 TF/s f32-class; set
-    # RAFT_TPU_PEAK_TFLOPS for the actual part)
-    try:
-        flops = float(compiled.cost_analysis()["flops"])
-    except Exception:
-        flops = float("nan")
-    peak_tf = float(os.environ.get("RAFT_TPU_PEAK_TFLOPS", "90"))
-    tflops_achieved = flops / dt / 1e12
-    device_kind = jax.devices()[0].device_kind
-
     # optional profiler capture (point RAFT_TPU_PROFILE at a directory
     # and open the trace in TensorBoard / Perfetto)
     prof_dir = os.environ.get("RAFT_TPU_PROFILE")
     if prof_dir:
         with jax.profiler.trace(prof_dir):
-            jax.block_until_ready(fn(*args))
+            jax.block_until_ready(compiled(*args))
 
-    # --- NumPy baseline: serial evaluation of ALL 12 cases (one full
-    # design evaluation), reference-style loops
+    base_design_evals_per_sec = _numpy_baseline(model)
+    breakdown = _flops_breakdown(compiled, dt)
+    breakdown.update(
+        compile_s=round(t_compile, 2),
+        statics_equilibrium_s=round(t_stat, 4) if t_stat else None,
+        drag_linearised_solve_s=round(t_dyn - t_stat, 4) if t_dyn else None,
+        response_psd_s=round(dt - t_dyn, 4) if t_dyn else None,
+        batch_designs=B, distinct_geometries=True,
+    )
+    print(json.dumps({
+        "metric": "design-evals/sec/chip (VolturnUS-S geometry DoE, 100w x 12 cases, operating turbine)",
+        "value": round(design_evals_per_sec, 3),
+        "unit": "design-evals/s",
+        "vs_baseline": round(design_evals_per_sec / base_design_evals_per_sec, 2),
+        "breakdown": breakdown,
+    }))
+
+
+def _flops_breakdown(compiled, dt):
+    """Achieved FLOP rate from XLA's own cost model + an MFU estimate
+    against the env-provided peak (default 90 TF/s f32-class; set
+    RAFT_TPU_PEAK_TFLOPS for the actual part).  Emits null (not NaN)
+    when cost analysis is unavailable so the JSON stays standard."""
+    import jax
+
+    try:
+        flops = float(compiled.cost_analysis()["flops"])
+    except Exception:
+        flops = None
+    peak_tf = float(os.environ.get("RAFT_TPU_PEAK_TFLOPS", "90"))
+    tflops = flops / dt / 1e12 if flops is not None else None
+    return dict(
+        xla_flops_per_batch=flops,
+        tflops_achieved=round(tflops, 4) if tflops is not None else None,
+        mfu_vs_peak=round(tflops / peak_tf, 6) if tflops is not None else None,
+        peak_tflops_assumed=peak_tf,
+        device_kind=jax.devices()[0].device_kind,
+    )
+
+
+def _numpy_baseline(model):
+    """Serial NumPy twin: design evaluations (12-case tables) per
+    second, reference-style loops."""
+    n_cases = len(CASES)
     n_base = int(os.environ.get("RAFT_TPU_BENCH_NBASE", str(n_cases)))
     cases = [dict(wind_speed=c[0], wind_heading=c[1], turbulence=c[2],
                   wave_height=c[3], wave_period=c[4], wave_heading=c[5])
@@ -578,26 +683,57 @@ def main():
     for i in range(n_base):
         numpy_eval_case(model, cases[i % n_cases])
     base_case_dt = (time.perf_counter() - t0) / n_base
-    base_design_evals_per_sec = 1.0 / (n_cases * base_case_dt)
+    return 1.0 / (n_cases * base_case_dt)
 
+
+def run_flat():
+    """Fallback configuration (round-2 proven): ONE baked geometry,
+    flat (B*12,) case batch through the geometry=False evaluator."""
+    import jax
+    import jax.numpy as jnp
+
+    import raft_tpu
+    from raft_tpu.api import make_full_evaluator
+    from raft_tpu.structure.schema import load_design
+
+    design = load_design(VOLTURN)
+    design["settings"]["min_freq"] = 0.002
+    design["settings"]["max_freq"] = 0.2
+    model = raft_tpu.Model(design)
+    evaluate = make_full_evaluator(model)
+
+    def eval_case(ws, wh, ti, hs, tp, bd):
+        return evaluate(dict(wind_speed=ws, wind_heading_deg=wh, TI=ti,
+                             Hs=hs, Tp=tp, beta_deg=bd))["PSD"]
+
+    n_cases = len(CASES)
+    arr = np.array(CASES)
+    B = int(os.environ.get("RAFT_TPU_BENCH_DESIGNS", "16"))
+    reps = int(os.environ.get("RAFT_TPU_BENCH_REPS", "3"))
+    tiled = np.tile(arr, (B, 1))
+    args = [jnp.asarray(tiled[:, j], dtype=jnp.float32) for j in range(6)]
+
+    fn = jax.jit(jax.vmap(eval_case))
+    t0 = time.perf_counter()
+    compiled = fn.lower(*args).compile()
+    t_compile = time.perf_counter() - t0
+    jax.block_until_ready(compiled(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(compiled(*args))
+    dt = (time.perf_counter() - t0) / reps
+    design_evals_per_sec = B / dt
+
+    base = _numpy_baseline(model)
+    breakdown = _flops_breakdown(compiled, dt)
+    breakdown.update(compile_s=round(t_compile, 2), batch_designs=B,
+                     distinct_geometries=False)
     print(json.dumps({
-        "metric": "design-evals/sec/chip (VolturnUS-S geometry DoE, 100w x 12 cases, operating turbine)",
+        "metric": "design-evals/sec/chip (VolturnUS-S, 100w x 12 cases, operating turbine)",
         "value": round(design_evals_per_sec, 3),
         "unit": "design-evals/s",
-        "vs_baseline": round(design_evals_per_sec / base_design_evals_per_sec, 2),
-        "breakdown": {
-            "compile_s": round(t_compile, 2),
-            "statics_equilibrium_s": round(t_stat, 4) if t_stat else None,
-            "drag_linearised_solve_s": round(t_dyn - t_stat, 4) if t_dyn else None,
-            "response_psd_s": round(dt - t_dyn, 4) if t_dyn else None,
-            "batch_designs": B,
-            "distinct_geometries": True,
-            "xla_flops_per_batch": flops,
-            "tflops_achieved": round(tflops_achieved, 4),
-            "mfu_vs_peak": round(tflops_achieved / peak_tf, 6),
-            "peak_tflops_assumed": peak_tf,
-            "device_kind": device_kind,
-        },
+        "vs_baseline": round(design_evals_per_sec / base, 2),
+        "breakdown": breakdown,
     }))
 
 

@@ -94,31 +94,39 @@ class Model:
 
                 from raft_tpu.physics.mooring import parse_moordyn
 
-                fpath = design["array_mooring"]["file"]
-                if self.base_dir is not None and not os.path.isabs(fpath):
-                    fpath = os.path.join(self.base_dir, fpath)
+                fpath = self._resolve_data_path(design["array_mooring"]["file"])
+                bpath = design["array_mooring"].get("bathymetry")
+                if bpath:
+                    bpath = self._resolve_data_path(bpath)
                 self.ms_array = parse_moordyn(
                     fpath, self.depth, rho=self.fowtList[0].rho_water,
-                    g=self.fowtList[0].g)
+                    g=self.fowtList[0].g, bathymetry=bpath)
         else:
             self.fowtList.append(FOWTStructure(design, depth=self.depth))
             fs = self.fowtList[0]
             if "mooring" in design and isinstance(design["mooring"], dict):
                 mo = design["mooring"]
                 if "file" in mo and "lines" not in mo:
-                    # MoorDyn-file mooring (e.g. lumped-mass examples):
-                    # quasi-static network treatment (moorMod dynamic
-                    # matrices are a follow-up milestone)
+                    # MoorDyn-file mooring: simple vessel-anchor files
+                    # become a full MooringSystem (all moorMod levels,
+                    # incl. the lumped-mass tension/impedance paths);
+                    # files with free/shared points take the
+                    # quasi-static network treatment
                     import os
 
-                    from raft_tpu.physics.mooring import parse_moordyn
+                    from raft_tpu.physics.mooring import (parse_moordyn,
+                                                          parse_moordyn_system)
 
-                    fpath = mo["file"]
-                    if self.base_dir is not None and not os.path.isabs(fpath):
-                        fpath = os.path.join(self.base_dir, fpath)
-                    self.ms_list.append(parse_moordyn(
-                        fpath, coerce(mo, "water_depth", default=self.depth),
-                        rho=fs.rho_water, g=fs.g))
+                    fpath = self._resolve_data_path(mo["file"])
+                    depth_mo = coerce(mo, "water_depth", default=self.depth)
+                    try:
+                        self.ms_list.append(parse_moordyn_system(
+                            fpath, depth_mo, rho=fs.rho_water, g=fs.g,
+                            moorMod=coerce(mo, "moorMod", default=0,
+                                           dtype=int)))
+                    except ValueError:
+                        self.ms_list.append(parse_moordyn(
+                            fpath, depth_mo, rho=fs.rho_water, g=fs.g))
                 else:
                     self.ms_list.append(
                         build_mooring(mo, rho_water=fs.rho_water, g=fs.g))
@@ -214,6 +222,12 @@ class Model:
         K_blocks, F_und_parts, F_env_parts = [], [], []
         C_elast_blocks = []
         for i, fs in enumerate(self.fowtList):
+            # reset to the undisplaced pose at case start, as the
+            # reference does before computing turbine constants and
+            # current loads (raft_model.py:599-621) — without this, pose
+            # state left by a previous case's solve_dynamics leaks into
+            # this case's mean environmental loads (order-dependence)
+            self.hydro[i].set_position(np.zeros(fs.nDOF))
             stat = self.statics(i)
             K_blocks.append(np.asarray(stat["C_struc"] + stat["C_hydro"]))
             C_elast_blocks.append(np.asarray(stat["C_elast"]))
@@ -373,6 +387,24 @@ class Model:
         tc = self.turbine_constants(case, ifowt)
         return jnp.asarray(np.sum(tc["f_aero0"], axis=1))
 
+    def qtf_slender(self, waveHeadInd=0, Xi0=None, ifowt=0):
+        """Slender-body QTF dispatcher for the potSecOrder == 1 flow:
+        the (w1 x w2) pair axis is physically partitioned over the
+        device mesh whenever more than one device is visible (the
+        sharded path is bitwise-compatible with the host path,
+        tests/test_qtf_slender.py), so large min_freq2nd grids scale
+        across chips transparently."""
+        import jax
+
+        if len(jax.devices()) > 1:
+            from raft_tpu.parallel.sweep import qtf_slender_sharded
+
+            return qtf_slender_sharded(
+                self, waveHeadInd, Xi0=Xi0, ifowt=ifowt)
+        from raft_tpu.physics.qtf_slender import fowt_qtf_slender
+
+        return fowt_qtf_slender(self, waveHeadInd, Xi0=Xi0, ifowt=ifowt)
+
     # -------------------------------------------------------------- dynamics
     def solve_dynamics(self, case, X0=None):
         """Iterative linearised dynamics for one case
@@ -462,11 +494,10 @@ class Model:
             # (raft_model.py:1108-1131)
             if fs.potSecOrder == 1 and self.w1_2nd is not None:
                 from raft_tpu.ops.waves import get_rao
-                from raft_tpu.physics.qtf_slender import fowt_qtf_slender
                 from raft_tpu.physics.secondorder import hydro_force_2nd
 
                 RAO = np.asarray(get_rao(Xi_i[:6], jnp.asarray(fh.zeta[0])))
-                qtf = fowt_qtf_slender(self, 0, Xi0=RAO, ifowt=i)
+                qtf = self.qtf_slender(0, Xi0=RAO, ifowt=i)
                 qtf_data = dict(w_2nd=self.w1_2nd,
                                 heads_rad=np.asarray([fh.beta[0]]), qtf=qtf)
                 for ih in range(nWaves):
@@ -477,7 +508,7 @@ class Model:
                 Z_i, Xi_i, Bmat, dyn_diag = solve_dynamics_fowt(
                     fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
                     jnp.asarray(self.w), fh.Tn, fh.r_nodes,
-                    n_iter=self.nIter, Xi_start=self.XiStart,
+                    n_iter=self.nIter, Xi_start=self.XiStart, Z_extra=Z_moor,
                 )
             Z_blocks.append(Z_i)
             Bmats.append(Bmat)
@@ -583,7 +614,6 @@ class Model:
             save_dir = os.environ.get(
                 "RAFT_TPU_BEM_DIR", os.path.join(os.getcwd(), "_bem_cache"))
         os.makedirs(save_dir, exist_ok=True)
-        prefix = os.path.join(save_dir, name)
 
         if w_bem is None:
             dw = float(coerce(settings, "dw_BEM", default=0.0) or 0.0)
@@ -596,12 +626,27 @@ class Model:
         if headings is None:
             headings = np.arange(0.0, 360.0, 45.0)
 
+        # mesh first (cheap host work), then key the cache by the panel
+        # geometry + solver inputs: same-named designs with different
+        # geometry (scaled members, per-FOWT differences in an array,
+        # different frequency grids/depths) get distinct entries
+        import hashlib
+
+        n_az_v = n_az or int(coerce(settings, "nAz_BEM", default=18, dtype=int))
+        dz_v = dz_max or (coerce(settings, "dz_BEM", default=0.0) or None)
+        v, c, nrm, a = mesh_fowt(fs, dz_max=dz_v, n_az=n_az_v)
+        if len(a) == 0:
+            return None
+        hsh = hashlib.sha256()
+        for arr in (v, a, np.asarray(w_bem, float),
+                    np.asarray(headings, float),
+                    np.asarray([self.depth, fs.rho_water, fs.g], float)):
+            hsh.update(np.ascontiguousarray(
+                np.asarray(arr, dtype=np.float64)).tobytes())
+        prefix = os.path.join(
+            save_dir, f"{name}_f{ifowt}_{hsh.hexdigest()[:12]}")
+
         if force or not os.path.exists(prefix + ".1"):
-            n_az_v = n_az or int(coerce(settings, "nAz_BEM", default=18, dtype=int))
-            dz_v = dz_max or (coerce(settings, "dz_BEM", default=0.0) or None)
-            v, c, nrm, a = mesh_fowt(fs, dz_max=dz_v, n_az=n_az_v)
-            if len(a) == 0:
-                return None
             from raft_tpu.native import solve_bem
 
             A, B, X = solve_bem(v, c, nrm, a, w_bem, headings_deg=headings,
@@ -799,8 +844,13 @@ class Model:
         for iCase, case in enumerate(self.cases):
             X0 = self.solve_statics(case)
             Xi, info = self.solve_dynamics(case, X0=X0)
-            # feed mean drift back into the equilibrium (raft_model.py:316-328)
-            if self.qtf is not None:
+            # feed mean drift back into the equilibrium for ANY 2nd-order
+            # configuration — the reference re-runs solveStatics with
+            # Fhydro_2nd_mean whenever potSecOrder > 0, slender-body QTFs
+            # included, and its golden means reflect that drift-included
+            # pose (raft_model.py:316-328, :625-628)
+            if self.qtf is not None or (self.w1_2nd is not None and any(
+                    fs.potSecOrder == 1 for fs in self.fowtList)):
                 X0 = self.solve_statics(
                     case, extra_force=np.sum(self._last_drift_mean, axis=0)
                 )

@@ -329,6 +329,9 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
             results[f"{key}_{suf}"] = np.zeros(nrot)
         results[f"{key}_PSD"] = np.zeros((model.nw, nrot))
     results["power_avg"] = np.zeros(nrot)
+    # per-rotor columns (the reference overwrites one (nw,) array per
+    # rotor, raft_fowt.py:2679, losing all but the last rotor)
+    results["wind_PSD"] = np.zeros((model.nw, nrot))
     RADPS2RPM = 60.0 / (2 * np.pi)
     for ir in range(nrot):
         ri = rotor_info[ir] if rotor_info else None
@@ -368,5 +371,5 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
         results["bPitch_PSD"][:, ir] = RAD2DEG**2 * np.asarray(
             get_psd(bPitch_w, dw, axis=0))
 
-        results["wind_PSD"] = np.asarray(get_psd(V_w, dw))
+        results["wind_PSD"][:, ir] = np.asarray(get_psd(V_w, dw))
     return results

@@ -197,16 +197,12 @@ def qtf_slender_sharded(model, waveHeadInd=0, Xi0=None, ifowt=0, mesh=None):
     qtf = np.zeros((nw2, nw2, 1, nDOF), dtype=complex)
     qtf[idx1, idx2, 0, :6] = Fpairs
 
-    # Pinkster IV rotation term (host-side, cheap)
+    # Pinkster IV rotation term: one blocked broadcast, not an
+    # O(nw2^2) Python loop (large min_freq2nd grids)
+    from raft_tpu.physics.qtf_slender import pinkster_iv
+
     F1st = np.asarray(stat["M_struc"]) @ (-(np.asarray(w2nd) ** 2) * Xi)
-    for j1 in range(nw2):
-        for j2 in range(j1, nw2):
-            Fr = np.zeros(nDOF, dtype=complex)
-            Fr[:3] = 0.25 * (np.cross(Xi[3:6, j1], np.conj(F1st[:3, j2]))
-                             + np.cross(np.conj(Xi[3:6, j2]), F1st[:3, j1]))
-            Fr[3:6] = 0.25 * (np.cross(Xi[3:6, j1], np.conj(F1st[3:6, j2]))
-                              + np.cross(np.conj(Xi[3:6, j2]), F1st[3:6, j1]))
-            qtf[j1, j2, 0, :] += Fr
+    qtf[:, :, 0, :6] += pinkster_iv(Xi, F1st)
 
     for mem, _ in members:
         qtf[:, :, 0, :6] += kim_yue_correction(

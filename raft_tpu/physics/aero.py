@@ -280,6 +280,16 @@ def _solve_phi(Vx, Vy, sigma_p, theta_rad, loss_const_tip, loss_const_hub,
         return s * jnp.maximum(jnp.abs(x), floor)
 
     def induction(phi):
+        """Returns (a, ap, one_m_a, one_p_ap).
+
+        (1-a) and (1+ap) are computed in algebraically-exact reciprocal
+        forms — 1-a = 1/(1+k) (momentum), (3F-5/3+sqrt(g2))/g3 (Buhl),
+        1/(1-k) (prop brake); 1+ap = 1/(1-kp) — NOT as 1 minus the
+        induction factor.  At bracket endpoints k is O(1e10) and a
+        rounds to exactly 1 in float32, so the subtractive form loses
+        the residual's SIGN, sending the bracket selection to the wrong
+        branch (measured: outer elements converging to phi=pi at
+        feathered operating points under float32)."""
         sphi, cphi = jnp.sin(phi), jnp.cos(phi)
         sphi_safe = _signed_floor(sphi, 1e-9)
         alpha = phi - theta_rad
@@ -296,63 +306,76 @@ def _solve_phi(Vx, Vy, sigma_p, theta_rad, loss_const_tip, loss_const_hub,
         k = sigma_p * cn / (4.0 * F * sphi_safe**2)
         kp = sigma_p * ct / (4.0 * F * sphi_safe * cphi)
         # axial induction: momentum / Buhl empirical (phi>0), prop brake
-        g1 = 2 * F * k - (10.0 / 9 - F)
         g2 = jnp.maximum(2 * F * k - F * (4.0 / 3 - F), 1e-12)
         g3 = 2 * F * k - (25.0 / 9 - 2 * F)
-        a_buhl = jnp.where(
-            jnp.abs(g3) < 1e-6, 1.0 - 1.0 / (2.0 * jnp.sqrt(g2)),
-            (g1 - jnp.sqrt(g2)) / jnp.where(jnp.abs(g3) < 1e-6, 1.0, g3),
-        )
-        a_mom = k / _signed_floor(1.0 + k, 1e-12)
-        a_pos = jnp.where(k <= 2.0 / 3, a_mom, a_buhl)
-        a_brake = jnp.where(k > 1.0, k / _signed_floor(k - 1.0, 1e-12), 0.0)
-        a = jnp.where(phi > 0, a_pos, a_brake)
-        # tangential induction
-        ap = kp / _signed_floor(1.0 - kp, 1e-12)
-        return a, ap, _signed_floor
+        # 1 - a_buhl = (g3 - g1 + sqrt(g2))/g3 with g3-g1 = F - 5/3 exactly;
+        # at g3 -> 0 both vanish together and the limit is 1/(2 sqrt(g2))
+        # (the reference's special case), used explicitly near zero
+        one_m_a_buhl = jnp.where(
+            jnp.abs(g3) < 1e-6, 1.0 / (2.0 * jnp.sqrt(g2)),
+            (F - 5.0 / 3 + jnp.sqrt(g2)) / _signed_floor(g3, 1e-6))
+        one_m_a_mom = 1.0 / _signed_floor(1.0 + k, 1e-12)
+        one_m_a_pos = jnp.where(k <= 2.0 / 3, one_m_a_mom, one_m_a_buhl)
+        # brake branch: 1 - k/(k-1) = 1/(1-k)
+        one_m_a_brake = jnp.where(k > 1.0, 1.0 / _signed_floor(1.0 - k, 1e-12), 1.0)
+        one_m_a = jnp.where(phi > 0, one_m_a_pos, one_m_a_brake)
+        one_p_ap = 1.0 / _signed_floor(1.0 - kp, 1e-12)
+        return 1.0 - one_m_a, one_p_ap - 1.0, one_m_a, one_p_ap
 
     def residual(phi):
-        a, ap, sf = induction(phi)
+        _, _, one_m_a, one_p_ap = induction(phi)
         sphi, cphi = jnp.sin(phi), jnp.cos(phi)
-        one_m_a = sf(1.0 - a, 1e-12)
-        one_p_ap = sf(1.0 + ap, 1e-12)
+        one_m_a = _signed_floor(one_m_a, 1e-12)
+        one_p_ap = _signed_floor(one_p_ap, 1e-12)
         return sphi / one_m_a - Vx / Vy * cphi / one_p_ap
 
     eps = 1e-6
-    lo = jnp.asarray(eps)
-    hi = jnp.asarray(jnp.pi / 2)
-    # fall back to the propeller-brake bracket if no sign change
-    r_lo, r_hi = residual(lo), residual(hi)
-    use_main = r_lo * r_hi <= 0
-    lo2, hi2 = jnp.asarray(jnp.pi / 2), jnp.asarray(jnp.pi - eps)
-    lo = jnp.where(use_main, lo, lo2)
-    hi = jnp.where(use_main, hi, hi2)
 
-    def bis(carry, _):
-        lo, hi = carry
-        mid = 0.5 * (lo + hi)
-        rm = residual(mid)
-        rl = residual(lo)
-        same = rm * rl > 0
-        lo = jnp.where(same, mid, lo)
-        hi = jnp.where(same, hi, mid)
-        return (lo, hi), None
+    def solve(f, phi0):
+        """Primal-only solve: bracketed bisection + Newton refinement.
+        Runs OUTSIDE the differentiation path (lax.custom_root)."""
+        lo = jnp.asarray(eps, dtype=phi0.dtype)
+        hi = jnp.asarray(jnp.pi / 2, dtype=phi0.dtype)
+        # fall back to the propeller-brake bracket if no sign change
+        r_lo, r_hi = f(lo), f(hi)
+        use_main = r_lo * r_hi <= 0
+        lo2 = jnp.asarray(jnp.pi / 2, dtype=phi0.dtype)
+        hi2 = jnp.asarray(jnp.pi - eps, dtype=phi0.dtype)
+        lo = jnp.where(use_main, lo, lo2)
+        hi = jnp.where(use_main, hi, hi2)
 
-    (lo, hi), _ = jax.lax.scan(bis, (lo, hi), None, length=n_bisect)
-    phi = 0.5 * (lo + hi)
-    phi = jax.lax.stop_gradient(phi)
+        def bis(carry, _):
+            lo, hi = carry
+            mid = 0.5 * (lo + hi)
+            same = f(mid) * f(lo) > 0
+            return (jnp.where(same, mid, lo), jnp.where(same, hi, mid)), None
 
-    # differentiable Newton refinement (implicit-function gradients)
-    dres = jax.grad(residual)
-    for _ in range(n_newton):
-        r = residual(phi)
-        d = dres(phi)
-        d = jnp.where(jnp.abs(d) < 1e-12, 1e-12, d)
-        step = jnp.clip(r / d, -0.1, 0.1)
-        phi = phi - step
+        (lo, hi), _ = jax.lax.scan(bis, (lo, hi), None, length=n_bisect)
+        phi = 0.5 * (lo + hi)
+        df = jax.grad(f)
+        for _ in range(n_newton):
+            d = df(phi)
+            d = jnp.where(jnp.abs(d) < 1e-12, 1e-12, d)
+            phi = phi - jnp.clip(f(phi) / d, -0.1, 0.1)
+        return phi
 
-    a, ap, _ = induction(phi)
-    return phi, a, ap
+    def tangent_solve(g, y):
+        # scalar linear solve: dphi = y / R'(phi*), with a signed floor on
+        # the slope so grazing roots cannot blow the tangents up
+        slope = g(jnp.ones_like(y))
+        slope = jnp.where(jnp.abs(slope) < 1e-8,
+                          jnp.where(slope < 0, -1e-8, 1e-8), slope)
+        return y / slope
+
+    # Implicit differentiation of the converged root (IFT): derivatives
+    # never see the bisection/Newton iterates.  Differentiating THROUGH
+    # the refinement chain (jacfwd over scan+Newton) amplifies float32
+    # roundoff catastrophically — measured dT/dU errors >10x and NaNs at
+    # feathered operating points — while the IFT tangent is O(eps).
+    phi = jax.lax.custom_root(residual, jnp.asarray(0.8, dtype=jnp.result_type(Vx, Vy, float)), solve, tangent_solve)
+
+    a, ap, one_m_a, one_p_ap = induction(phi)
+    return phi, a, ap, one_m_a, one_p_ap
 
 
 def _wind_components(rot: RotorAeroModel, Uinf, Omega_radps, azimuth_rad,
@@ -397,14 +420,15 @@ def rotor_loads(rot: RotorAeroModel, Uinf, Omega_rpm, pitch_deg, tilt, yaw):
     azimuths = jnp.arange(rot.nSector) * (2 * jnp.pi / rot.nSector)
 
     def per_element(Vx, Vy, th, sg, lt, lh, cl_t, cd_t, ch):
-        phi, a, ap = _solve_phi(Vx, Vy, sg, th, lt, lh, cl_t, cd_t, aoa_rad)
+        phi, a, ap, one_m_a, one_p_ap = _solve_phi(
+            Vx, Vy, sg, th, lt, lh, cl_t, cd_t, aoa_rad)
         sphi, cphi = jnp.sin(phi), jnp.cos(phi)
         alpha = phi - th
         cl = jnp.interp(alpha, aoa_rad, cl_t)
         cd = jnp.interp(alpha, aoa_rad, cd_t)
         cn = cl * cphi + cd * sphi
         ct_ = cl * sphi - cd * cphi
-        W2 = (Vx * (1 - a)) ** 2 + (Vy * (1 + ap)) ** 2
+        W2 = (Vx * one_m_a) ** 2 + (Vy * one_p_ap) ** 2
         qdyn = 0.5 * rot.rho * W2 * ch
         return cn * qdyn, ct_ * qdyn  # Np, Tp per unit span
 
@@ -730,9 +754,10 @@ def calc_cavitation(rot: RotorAeroModel, rprops, case, Patm=101325.0,
                                   0.0, jnp.asarray(x_az), jnp.asarray(y_az),
                                   jnp.asarray(z_az), jnp.asarray(cone))
         for ie in range(len(rot.r)):
-            phi, a, ap = _solve_phi(Vx[ie], Vy[ie], sigma_p[ie], theta_r[ie],
-                                    lct[ie], lch[ie], jnp.asarray(rot.cl[ie]),
-                                    jnp.asarray(rot.cd[ie]), aoa_rad)
+            phi, a, ap, _, _ = _solve_phi(
+                Vx[ie], Vy[ie], sigma_p[ie], theta_r[ie],
+                lct[ie], lch[ie], jnp.asarray(rot.cl[ie]),
+                jnp.asarray(rot.cd[ie]), aoa_rad)
             phi, a, ap = float(phi), float(a), float(ap)
             W2 = (float(Vx[ie]) * (1 - a)) ** 2 + (float(Vy[ie]) * (1 + ap)) ** 2
             alpha = np.degrees(phi) - (rot.theta_deg[ie] + pit)

@@ -273,10 +273,16 @@ class MooringNetwork:
     lines) come from ``jax.jacfwd``.
     """
 
-    def __init__(self, depth, g=9.81, rho=1025.0):
+    def __init__(self, depth, g=9.81, rho=1025.0, bathymetry=None):
         self.depth = float(depth)
         self.g = g
         self.rho = rho
+        # optional (x_grid, y_grid, depth_grid) bathymetry; when set,
+        # the local seabed depth at each point's (x, y) replaces the
+        # uniform depth in the anchor/grounding classification (the
+        # functional effect of the reference's MoorPy bathymetry at the
+        # quasi-static fidelity, raft_model.py:87-91)
+        self.bathymetry = bathymetry
         # points
         self.p_kind = []     # 0 fixed, 1 body-attached, 2 free
         self.p_body = []     # body index for kind 1
@@ -313,16 +319,34 @@ class MooringNetwork:
         self.free_idx = np.where(self.p_kind == 2)[0]
         self.n_bodies = int(self.p_body.max()) + 1 if len(self.p_body) else 0
         # a line end can rest on the seabed only if its lower end is a
-        # fixed point at the seabed
+        # fixed point at the seabed (local bathymetry depth when a grid
+        # is attached)
         self.l_can_ground = []
         for (a, b) in self.l_ends:
             ground = False
             for p in (a, b):
-                if self.p_kind[p] == 0 and self.p_r[p][2] <= -self.depth + 1.0:
+                if self.p_kind[p] == 0 and \
+                        self.p_r[p][2] <= -self.depth_at(*self.p_r[p][:2]) + 1.0:
                     ground = True
             self.l_can_ground.append(ground)
         self.l_can_ground = np.asarray(self.l_can_ground)
         return self
+
+    def depth_at(self, x, y):
+        """Local seabed depth [m, positive down] at (x, y): bilinear on
+        the bathymetry grid when present, else the uniform depth."""
+        if self.bathymetry is None:
+            return self.depth
+        xg, yg, dg = self.bathymetry
+        ix = int(np.clip(np.searchsorted(xg, x) - 1, 0, len(xg) - 2))
+        iy = int(np.clip(np.searchsorted(yg, y) - 1, 0, len(yg) - 2))
+        fx = np.clip((x - xg[ix]) / (xg[ix + 1] - xg[ix]), 0.0, 1.0)
+        fy = np.clip((y - yg[iy]) / (yg[iy + 1] - yg[iy]), 0.0, 1.0)
+        return float(
+            dg[iy, ix] * (1 - fx) * (1 - fy)
+            + dg[iy, ix + 1] * fx * (1 - fy)
+            + dg[iy + 1, ix] * (1 - fx) * fy
+            + dg[iy + 1, ix + 1] * fx * fy)
 
     # ---------------------------------------------------------- physics
     def _point_positions(self, r6_bodies, r_free):
@@ -435,14 +459,155 @@ class MooringNetwork:
         return -jax.jacfwd(f)(jnp.asarray(r6_all).reshape(-1))
 
 
-def parse_moordyn(path, depth, rho=1025.0, g=9.81):
+def read_bathymetry(path):
+    """Read a MoorPy-style bathymetry grid file
+    (``--- MoorPy Bathymetry Input File ---`` header, nGridX/nGridY,
+    x row, then ``y d d d ...`` rows).  Returns (x (nx,), y (ny,),
+    depth (ny, nx)) with depth positive-down [m]."""
+    rows = []
+    xg = yg = None
+    nx = ny = None
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("---"):
+                continue
+            toks = line.split()
+            key = toks[0].lower()
+            if key == "ngridx":
+                nx = int(toks[1])
+            elif key == "ngridy":
+                ny = int(toks[1])
+            elif xg is None:
+                xg = np.asarray(toks, dtype=float)
+            else:
+                yg_row = float(toks[0])
+                rows.append((yg_row, np.asarray(toks[1:], dtype=float)))
+    yg = np.asarray([r[0] for r in rows])
+    dg = np.stack([r[1] for r in rows])
+    if nx is not None and (len(xg) != nx or dg.shape != (ny, nx)):
+        raise ValueError(
+            f"bathymetry grid shape {dg.shape} does not match declared "
+            f"nGridX={nx} nGridY={ny} in {path}")
+    return xg, yg, dg
+
+
+def parse_moordyn_system(path, depth, rho=1025.0, g=9.81, moorMod=0):
+    """Parse a SIMPLE MoorDyn file — every line connecting one Vessel
+    point to one Fixed point, no free/shared connections — into a
+    :class:`MooringSystem` with full line-dynamics properties (Diam /
+    MassDen / Cd / Ca / CdAx / CaAx columns), so file-based moorings
+    support moorMod 1/2 exactly like schema-based ones
+    (raft_fowt.py:359-370 MoorPy load + lines2ss).
+
+    Raises ValueError when the file needs the network treatment
+    (free points, shared lines) — callers fall back to
+    :func:`parse_moordyn`.
+    """
+    types = {}
+    points = {}
+    lines = []
+    section = None
+    with open(path) as f:
+        for raw in f:
+            line = raw.strip()
+            if not line:
+                continue
+            up = line.upper()
+            if up.startswith("---"):
+                if "LINE TYPE" in up:
+                    section = "types"
+                elif "POINT" in up or "CONNECTION" in up:
+                    section = "points"
+                elif "- LINES" in up or up.strip("- ").startswith("LINES"):
+                    section = "lines"
+                else:
+                    section = None
+                continue
+            toks = line.split()
+            if section == "types" and len(toks) >= 4:
+                try:
+                    d = float(toks[1])
+                except ValueError:
+                    continue
+                types[toks[0]] = dict(
+                    d=d, m=float(toks[2]), EA=float(toks[3]),
+                    Cd=float(toks[6]) if len(toks) > 6 else 1.2,
+                    Ca=float(toks[7]) if len(toks) > 7 else 1.0,
+                    CdAx=float(toks[8]) if len(toks) > 8 else 0.05,
+                    CaAx=float(toks[9]) if len(toks) > 9 else 0.0,
+                )
+            elif section == "points" and len(toks) >= 5:
+                try:
+                    pid = int(toks[0])
+                except ValueError:
+                    continue
+                points[pid] = (toks[1].lower(),
+                               np.array([float(toks[2]), float(toks[3]),
+                                         float(toks[4])]))
+            elif section == "lines" and len(toks) >= 5:
+                try:
+                    int(toks[0])
+                except ValueError:
+                    continue
+                lines.append((toks[1], int(toks[2]), int(toks[3]),
+                              float(toks[4])))
+
+    r_anchor, r_fair, L = [], [], []
+    w_l, EA, m_l, d_l, Cd_l, Ca_l, CdAx_l, CaAx_l = [], [], [], [], [], [], [], []
+    for (tname, a, b, length) in lines:
+        ka, ra = points[a]
+        kb, rb = points[b]
+
+        def kind(att):
+            if att.startswith(("fix", "anch")):
+                return "fixed"
+            if att.startswith(("vessel", "coupled", "body", "turbine")):
+                return "vessel"
+            return "other"
+
+        if kind(ka) == "fixed" and kind(kb) == "vessel":
+            anc, fair = ra, rb
+        elif kind(kb) == "fixed" and kind(ka) == "vessel":
+            anc, fair = rb, ra
+        else:
+            raise ValueError(
+                f"line {tname} connects {ka}-{kb}: needs the network "
+                "treatment (free/shared points)")
+        lt = types[tname]
+        r_anchor.append(anc)
+        r_fair.append(fair)
+        L.append(length)
+        w_l.append((lt["m"] - rho * np.pi / 4 * lt["d"] ** 2) * g)
+        EA.append(lt["EA"])
+        m_l.append(lt["m"])
+        d_l.append(lt["d"])
+        Cd_l.append(lt["Cd"])
+        Ca_l.append(lt["Ca"])
+        CdAx_l.append(lt["CdAx"])
+        CaAx_l.append(lt["CaAx"])
+    if not lines:
+        raise ValueError("no lines found")
+    return MooringSystem(
+        r_anchor=np.array(r_anchor), r_fair0=np.array(r_fair),
+        L=np.array(L), w=np.array(w_l), EA=np.array(EA), depth=float(depth),
+        m_lin=np.array(m_l), d_vol=np.array(d_l), Cd=np.array(Cd_l),
+        Ca=np.array(Ca_l), CdAx=np.array(CdAx_l), CaAx=np.array(CaAx_l),
+        moorMod=int(moorMod),
+    )
+
+
+def parse_moordyn(path, depth, rho=1025.0, g=9.81, bathymetry=None):
     """Parse a MoorDyn v1/v2 input file into a MooringNetwork.
 
     Supports LINE TYPES / POINTS / LINES sections with Fixed, Free,
     Vessel, Coupled, Turbine<N> and Body<N> attachments (the subset the
     reference consumes through MoorPy's System.load,
-    raft_model.py:98-100)."""
-    net = MooringNetwork(depth, g=g, rho=rho)
+    raft_model.py:98-100).  ``bathymetry``: optional path to a
+    MoorPy-style grid file (raft_model.py:87-91)."""
+    bath = read_bathymetry(bathymetry) if isinstance(bathymetry, str) \
+        else bathymetry
+    net = MooringNetwork(depth, g=g, rho=rho, bathymetry=bath)
     types = {}
     section = None
     point_ids = {}

@@ -66,41 +66,62 @@ class StripSet:
 
 
 _MCF_TABLE = None
-_MCF_KR_MAX = 60.0
-_MCF_N = 1 << 20
+_MCF_KR_MAX = 80.0
+_MCF_N = 1 << 15
 
 
-def mcf_cm(kR):
-    """MacCamy-Fuchs complex inertia coefficient Cm(kR) = 4i/(pi (kR)^2
-    H1'(kR)) as a universal function of kR (raft_member.py:1467-1478).
+def _mcf_table():
+    """Cm(x) and its exact analytic derivative on the table grid.
 
-    Evaluated through one dense precomputed table (~3e-10 relative
-    interp error) so the numpy build path and the traced geometry path
-    (kR = k * R * d_scale) produce identical values.  Works on numpy or
-    jax arrays of any shape.
-    """
+    dCm/dx = (4i/pi) * (-2 x^-3 / H1' - x^-2 H1'' / H1'^2) with
+    H1''(x) = H2(x)/x - H1(x) (Bessel recurrences), so the table
+    supports cubic-HERMITE interpolation: ~7e-12 max relative error on
+    the ramp-blended quantity over kR in [1e-4, 80] (measured; see
+    test_ops), where the previous 2^20-point LINEAR table reached only
+    ~2e-9 while embedding a 16 MB constant into every trace that used
+    it."""
     global _MCF_TABLE
     if _MCF_TABLE is None:
         from scipy.special import hankel1
 
         x = np.linspace(0.0, _MCF_KR_MAX, _MCF_N)
         with np.errstate(all="ignore"):
-            Hp1 = 0.5 * (hankel1(0, x) - hankel1(2, x))
+            H1 = hankel1(1, x)
+            H2 = hankel1(2, x)
+            Hp1 = 0.5 * (hankel1(0, x) - H2)
             Cm = 4j / (np.pi * x**2 * Hp1)
-        _MCF_TABLE = (np.nan_to_num(Cm.real), np.nan_to_num(Cm.imag))
-    re, im = _MCF_TABLE
-    dx = _MCF_KR_MAX / (_MCF_N - 1)
-    if isinstance(kR, jnp.ndarray):
-        xq = jnp.clip(kR, 0.0, _MCF_KR_MAX)
-        i = jnp.clip((xq / dx).astype(int), 0, _MCF_N - 2)
-        f = xq / dx - i
-        re_j, im_j = jnp.asarray(re), jnp.asarray(im)
-        return (re_j[i] * (1 - f) + re_j[i + 1] * f) + 1j * (
-            im_j[i] * (1 - f) + im_j[i + 1] * f)
-    xq = np.clip(np.asarray(kR, dtype=float), 0.0, _MCF_KR_MAX)
-    i = np.clip((xq / dx).astype(int), 0, _MCF_N - 2)
-    f = xq / dx - i
-    return (re[i] * (1 - f) + re[i + 1] * f) + 1j * (im[i] * (1 - f) + im[i + 1] * f)
+            dCm = (4j / np.pi) * (-2.0 / (x**3 * Hp1)
+                                  - (H2 / x - H1) / (x**2 * Hp1**2))
+        # analytic x->0 limits (Cm -> 2, dCm -> 0); the raw expressions
+        # are 0/0 at the first node
+        Cm[0] = 2.0
+        dCm[0] = 0.0
+        _MCF_TABLE = (np.nan_to_num(Cm), np.nan_to_num(dCm))
+    return _MCF_TABLE
+
+
+def mcf_cm(kR):
+    """MacCamy-Fuchs complex inertia coefficient Cm(kR) = 4i/(pi (kR)^2
+    H1'(kR)) as a universal function of kR (raft_member.py:1467-1478).
+
+    Evaluated through one compact cubic-Hermite table (~7e-12 relative
+    error for kR <= 80; clamped beyond, where the factor is ~4e-4 from
+    its asymptote) so the numpy build path and the traced geometry path
+    (kR = k * R * d_scale) produce identical values.  Works on numpy or
+    jax arrays of any shape.
+    """
+    Cm_t, dCm_t = _mcf_table()
+    h = _MCF_KR_MAX / (_MCF_N - 1)
+    xp = jnp if isinstance(kR, jnp.ndarray) else np
+    xq = xp.clip(xp.asarray(kR, dtype=float), 0.0, _MCF_KR_MAX)
+    i = xp.clip((xq / h).astype(int), 0, _MCF_N - 2)
+    t = xq / h - i
+    y0, y1 = xp.asarray(Cm_t)[i], xp.asarray(Cm_t)[i + 1]
+    d0, d1 = xp.asarray(dCm_t)[i], xp.asarray(dCm_t)[i + 1]
+    t2 = t * t
+    t3 = t2 * t
+    return ((2 * t3 - 3 * t2 + 1) * y0 + (t3 - 2 * t2 + t) * (h * d0)
+            + (-2 * t3 + 3 * t2) * y1 + (t3 - t2) * (h * d1))
 
 
 def mcf_blend(kR, Cm0_p1, Cm0_p2):

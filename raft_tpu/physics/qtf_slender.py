@@ -355,6 +355,42 @@ def kim_yue_correction(mem, beta, w2nd, k2nd, depth, rho, g, Nm=10):
     return out
 
 
+def pinkster_iv(Xi, F1st, block=512):
+    """Pinkster term IV — rotation of the first-order inertial forces
+    (raft_fowt.py:2052-2061) — for ALL upper-triangle (w1, w2) pairs in
+    one broadcast cross product per block.
+
+    Xi : (nDOF, nw2) motion RAOs on the QTF grid;
+    F1st : (nDOF, nw2) first-order inertial forces.
+    Returns (nw2, nw2, 6) complex with only the upper triangle filled
+    (the lower triangle is completed by the callers' hermitian step).
+
+    Replaces the O(nw2^2) host-side Python double loop: at the
+    min_freq2nd-driven grid sizes the sharded driver targets (thousands
+    of bins) the loop's millions of scalar cross products dominated the
+    runtime the pair-axis sharding was built to remove.  Blocked over
+    w1 to bound the (block, nw2, 3) temporaries.
+    """
+    nw2 = Xi.shape[1]
+    Xr = np.asarray(Xi[3:6]).T          # (nw2, 3)
+    Fl = np.asarray(F1st[:3]).T         # (nw2, 3)
+    Fr_ = np.asarray(F1st[3:6]).T       # (nw2, 3)
+    Xrc, Flc, Frc = np.conj(Xr), np.conj(Fl), np.conj(Fr_)
+    out = np.zeros((nw2, nw2, 6), dtype=complex)
+    j = np.arange(nw2)
+    for s in range(0, nw2, block):
+        e = min(s + block, nw2)
+        mask = (j[s:e, None] <= j[None, :])[..., None]  # upper triangle
+        # entry (j1, j2): cross(Xi_rot[j1], conj(F[j2])) + cross(conj(Xi_rot[j2]), F[j1])
+        out[s:e, :, 0:3] = 0.25 * mask * (
+            np.cross(Xr[s:e, None, :], Flc[None, :, :])
+            + np.cross(Xrc[None, :, :], Fl[s:e, None, :]))
+        out[s:e, :, 3:6] = 0.25 * mask * (
+            np.cross(Xr[s:e, None, :], Frc[None, :, :])
+            + np.cross(Xrc[None, :, :], Fr_[s:e, None, :]))
+    return out
+
+
 def fowt_qtf_slender(model, waveHeadInd=0, Xi0=None, ifowt=0):
     """System-level slender-body QTF (FOWT.calcQTF_slenderBody twin).
 
@@ -379,14 +415,7 @@ def fowt_qtf_slender(model, waveHeadInd=0, Xi0=None, ifowt=0):
 
     # Pinkster IV: rotation of first-order inertial forces (raft_fowt.py:2052-2061)
     F1st = np.asarray(stat["M_struc"]) @ (-(np.asarray(w2nd) ** 2) * Xi)
-    for i1 in range(nw2):
-        for i2 in range(i1, nw2):
-            Fr = np.zeros(nDOF, dtype=complex)
-            Fr[:3] = 0.25 * (np.cross(Xi[3:, i1], np.conj(F1st[:3, i2]))
-                             + np.cross(np.conj(Xi[3:, i2]), F1st[:3, i1]))
-            Fr[3:] = 0.25 * (np.cross(Xi[3:, i1], np.conj(F1st[3:, i2]))
-                             + np.cross(np.conj(Xi[3:, i2]), F1st[3:, i1]))
-            qtf[i1, i2, 0, :] = Fr
+    qtf[:, :, 0, :6] = pinkster_iv(Xi, F1st)
 
     # per-member slender-body terms + Kim & Yue correction
     # a_i per member from the hydro-constants stage (zero pose)

@@ -441,13 +441,16 @@ def calc_statics(fs, Xi0=None):
         for p in mem.pfill:
             if p != 0 and p not in pb:
                 pb.append(p)
-    m_ballast = np.zeros(len(pb))
+    # accumulate as jax scalars: mfill may be traced (geometry axis)
+    m_ballast_l = [jnp.asarray(0.0)] * len(pb)
     for mem in fs.members:
         if mem.part_of == "nacelle":
             continue
         for mf, p in zip(mem.mfill, mem.pfill):
             if p != 0:
-                m_ballast[pb.index(p)] += mf
+                i = pb.index(p)
+                m_ballast_l[i] = m_ballast_l[i] + mf
+    m_ballast = jnp.stack(m_ballast_l) if pb else jnp.zeros(0)
 
     return dict(
         M_struc=M_struc,
@@ -467,7 +470,7 @@ def calc_statics(fs, Xi0=None):
         V=VTOT,
         AWP=AWP_TOT,
         rM=jnp.stack([rCB[0], rCB[1], zMeta]),
-        m_ballast=jnp.asarray(m_ballast),
+        m_ballast=m_ballast,
         pb=pb,
         mtower=mtower,
         rCG_tow=rCG_tow,

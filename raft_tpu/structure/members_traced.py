@@ -239,91 +239,106 @@ def traced_inertia_elements(g, d, t, l_fill, rho_fill):
     with exactly the static element layout of the build-time path
     (sections incl. the reference's zero-length-section quirk, then
     caps), plus (mshell, mfill (n-1,)).
+
+    All sections are computed in one VECTORISED pass over the section
+    axis; the zero-length-section quirk (re-adds the previous section's
+    CG inertia with zero mass, members.py:597-614) is a static index
+    map, so the element layout is one gather.  The per-section scalar
+    formulation this replaces emitted thousands of scalar HLO ops per
+    FOWT — a major contributor to evaluator compile time on the
+    geometry axis.
     """
-    st = g.stations
+    st = np.asarray(g.stations, dtype=float)
     n = len(st)
-    masses, ss, Ixxs, Iyys, Izzs = [], [], [], [], []
-    mshell = jnp.asarray(0.0)
-    mfill = []
+    lsec_np = np.diff(st)                       # static section lengths
+    pos = lsec_np > 0                           # static validity mask
+    lsec = jnp.asarray(np.where(pos, lsec_np, 1.0))  # safe divisor
+    posj = jnp.asarray(pos, dtype=float)
+    lf = jnp.asarray(l_fill)
+    rf = jnp.asarray(rho_fill)
 
-    for i in range(1, n):
-        lsec = float(st[i] - st[i - 1])
-        if lsec <= 0:
-            # zero-length-section quirk: re-adds the previous section's
-            # CG inertia with zero mass (members.py:597-614)
-            if masses:
-                masses.append(jnp.asarray(0.0))
-                ss.append(jnp.asarray(0.0))
-                Ixxs.append(Ixxs[-1])
-                Iyys.append(Iyys[-1])
-                Izzs.append(Izzs[-1])
-            mfill.append(jnp.asarray(0.0))
-            continue
-        lf = l_fill[i - 1]
-        rf = rho_fill[i - 1]
+    if g.circular:
+        dA, dB = d[:-1, 0], d[1:, 0]
+        dAi = dA - 2 * t[:-1]
+        dBi = dB - 2 * t[1:]
+        V_o, hco = vcv_circ(dA, dB, lsec)
+        V_i, hci = vcv_circ(dAi, dBi, lsec)
+        m_shell = (V_o - V_i) * g.rho_shell * posj
+        hc_shell = _sdiv(hco * V_o - hci * V_i, V_o - V_i)
+        dBi_fill = (dBi - dAi) * (lf / lsec) + dAi
+        v_fill, hc_fill = vcv_circ(dAi, dBi_fill, lf)
+        m_fill = v_fill * rf * posj
+        mass = m_shell + m_fill
+        hc = _sdiv(hc_fill * m_fill + hc_shell * m_shell, mass)
+        Ir_o, Ia_o = moi_circ(dA, dB, lsec, g.rho_shell)
+        Ir_i, Ia_i = moi_circ(dAi, dBi, lsec, g.rho_shell)
+        Ir_f, Ia_f = moi_circ(dAi, dBi_fill, lf, rf)
+        I_rad = ((Ir_o - Ir_i) * posj + Ir_f * posj) - mass * hc**2
+        Ixx_s, Iyy_s = I_rad, I_rad
+        Izz_s = ((Ia_o - Ia_i) + Ia_f) * posj
+    else:
+        slA, slB = d[:-1], d[1:]                # (n-1, 2)
+        slAi = slA - 2 * t[:-1, None]
+        slBi = slB - 2 * t[1:, None]
+        V_o, hco = vcv_rect(slA.T, slB.T, lsec)
+        V_i, hci = vcv_rect(slAi.T, slBi.T, lsec)
+        m_shell = (V_o - V_i) * g.rho_shell * posj
+        hc_shell = _sdiv(hco * V_o - hci * V_i, V_o - V_i)
+        slBi_fill = (slBi - slAi) * (lf / lsec)[:, None] + slAi
+        v_fill, hc_fill = vcv_rect(slAi.T, slBi_fill.T, lf)
+        m_fill = v_fill * rf * posj
+        mass = m_shell + m_fill
+        hc = _sdiv(hc_fill * m_fill + hc_shell * m_shell, mass)
+        Ix_o, Iy_o, Iz_o = moi_rect(slA[:, 0], slA[:, 1], slB[:, 0],
+                                    slB[:, 1], lsec, g.rho_shell)
+        Ix_i, Iy_i, Iz_i = moi_rect(slAi[:, 0], slAi[:, 1], slBi[:, 0],
+                                    slBi[:, 1], lsec, g.rho_shell)
+        Ix_f, Iy_f, Iz_f = moi_rect(slAi[:, 0], slAi[:, 1], slBi_fill[:, 0],
+                                    slBi_fill[:, 1], lf, rf)
+        Ixx_s = ((Ix_o - Ix_i) + Ix_f) * posj - mass * hc**2
+        Iyy_s = ((Iy_o - Iy_i) + Iy_f) * posj - mass * hc**2
+        Izz_s = ((Iz_o - Iz_i) + Iz_f) * posj
 
-        if g.circular:
-            dA, dB = d[i - 1, 0], d[i, 0]
-            dAi = dA - 2 * t[i - 1]
-            dBi = dB - 2 * t[i]
-            V_o, hco = vcv_circ(dA, dB, lsec)
-            V_i, hci = vcv_circ(dAi, dBi, lsec)
-            m_shell = (V_o - V_i) * g.rho_shell
-            hc_shell = _sdiv(hco * V_o - hci * V_i, V_o - V_i)
-            dBi_fill = (dBi - dAi) * (lf / lsec) + dAi
-            v_fill, hc_fill = vcv_circ(dAi, dBi_fill, lf)
-            m_fill = v_fill * rf
-            mass = m_shell + m_fill
-            hc = _sdiv(hc_fill * m_fill + hc_shell * m_shell, mass)
-            Ir_o, Ia_o = moi_circ(dA, dB, lsec, g.rho_shell)
-            Ir_i, Ia_i = moi_circ(dAi, dBi, lsec, g.rho_shell)
-            Ir_f, Ia_f = moi_circ(dAi, dBi_fill, lf, rf)
-            I_rad = (Ir_o - Ir_i) + Ir_f - mass * hc**2
-            Ixx, Iyy, Izz = I_rad, I_rad, (Ia_o - Ia_i) + Ia_f
-        else:
-            slA, slB = d[i - 1], d[i]
-            slAi = slA - 2 * t[i - 1]
-            slBi = slB - 2 * t[i]
-            V_o, hco = vcv_rect(slA, slB, lsec)
-            V_i, hci = vcv_rect(slAi, slBi, lsec)
-            m_shell = (V_o - V_i) * g.rho_shell
-            hc_shell = _sdiv(hco * V_o - hci * V_i, V_o - V_i)
-            slBi_fill = (slBi - slAi) * (lf / lsec) + slAi
-            v_fill, hc_fill = vcv_rect(slAi, slBi_fill, lf)
-            m_fill = v_fill * rf
-            mass = m_shell + m_fill
-            hc = _sdiv(hc_fill * m_fill + hc_shell * m_shell, mass)
-            Ix_o, Iy_o, Iz_o = moi_rect(slA[0], slA[1], slB[0], slB[1], lsec, g.rho_shell)
-            Ix_i, Iy_i, Iz_i = moi_rect(slAi[0], slAi[1], slBi[0], slBi[1], lsec, g.rho_shell)
-            Ix_f, Iy_f, Iz_f = moi_rect(slAi[0], slAi[1], slBi_fill[0], slBi_fill[1], lf, rf)
-            Ixx = (Ix_o - Ix_i) + Ix_f - mass * hc**2
-            Iyy = (Iy_o - Iy_i) + Iy_f - mass * hc**2
-            Izz = (Iz_o - Iz_i) + Iz_f
+    s_sec = jnp.asarray(st[:-1]) + hc
 
-        masses.append(mass)
-        ss.append(st[i - 1] + hc)
-        Ixxs.append(Ixx)
-        Iyys.append(Iyy)
-        Izzs.append(Izz)
-        mshell = mshell + m_shell
-        mfill.append(m_fill)
+    # static element layout: section index + mass/s mask per element;
+    # zero-length sections reuse the PREVIOUS real section's inertia
+    # with zero mass (and are skipped entirely before any real section)
+    idx, msk = [], []
+    prev = -1
+    for j in range(n - 1):
+        if pos[j]:
+            idx.append(j)
+            msk.append(1.0)
+            prev = j
+        elif prev >= 0:
+            idx.append(prev)
+            msk.append(0.0)
+    idx = np.asarray(idx, dtype=int)
+    msk_j = jnp.asarray(np.asarray(msk))
+    elem_mass = mass[idx] * msk_j
+    elem_s = s_sec[idx] * msk_j
+    elem_Ixx = Ixx_s[idx]
+    elem_Iyy = Iyy_s[idx]
+    elem_Izz = Izz_s[idx]
+    mshell = jnp.sum(m_shell)
+    mfill = m_fill
 
-    for (m_cap, s_cg, Ixx, Iyy, Izz) in traced_cap_elements(g, d, t):
-        masses.append(m_cap)
-        ss.append(s_cg)
-        Ixxs.append(Ixx)
-        Iyys.append(Iyy)
-        Izzs.append(Izz)
-        mshell = mshell + m_cap
+    caps = traced_cap_elements(g, d, t)
+    if caps:
+        cm = jnp.stack([jnp.asarray(c[0], dtype=float) for c in caps])
+        cs = jnp.stack([jnp.asarray(c[1], dtype=float) for c in caps])
+        cx = jnp.stack([jnp.asarray(c[2], dtype=float) for c in caps])
+        cy = jnp.stack([jnp.asarray(c[3], dtype=float) for c in caps])
+        cz = jnp.stack([jnp.asarray(c[4], dtype=float) for c in caps])
+        elem_mass = jnp.concatenate([elem_mass, cm])
+        elem_s = jnp.concatenate([elem_s, cs])
+        elem_Ixx = jnp.concatenate([elem_Ixx, cx])
+        elem_Iyy = jnp.concatenate([elem_Iyy, cy])
+        elem_Izz = jnp.concatenate([elem_Izz, cz])
+        mshell = mshell + jnp.sum(cm)
 
-    return (jnp.stack([jnp.asarray(x, dtype=float) for x in masses]),
-            jnp.stack([jnp.asarray(x, dtype=float) for x in ss]),
-            jnp.stack([jnp.asarray(x, dtype=float) for x in Ixxs]),
-            jnp.stack([jnp.asarray(x, dtype=float) for x in Iyys]),
-            jnp.stack([jnp.asarray(x, dtype=float) for x in Izzs]),
-            mshell,
-            jnp.stack([jnp.asarray(x, dtype=float) for x in mfill])
-            if mfill else jnp.zeros(0))
+    return (elem_mass, elem_s, elem_Ixx, elem_Iyy, elem_Izz, mshell, mfill)
 
 
 # --------------------------------------------------------- FOWT assembly
@@ -371,6 +386,10 @@ def apply_geometry(fs, ss0, params, k=None):
             mem, d=d, t=t, l_fill=lf, rho_fill=rf,
             ds=jnp.asarray(mem.ds) * d_s[im], drs=jnp.asarray(mem.drs) * d_s[im],
             elem_mass=em, elem_s=es, elem_Ixx=ex, elem_Iyy=ey, elem_Izz=ez,
+            # traced shell/ballast bookkeeping so calc_statics
+            # diagnostics (m_ballast, mshell totals) track the scaled
+            # geometry instead of the build-time design
+            mshell=mshell, mfill=mfill,
         ))
     fs2 = copy.copy(fs)
     fs2.members = members2

@@ -342,16 +342,21 @@ class Topology:
         r0 = np.array([n.r0 for n in self.nodes])
         T_cur = np.asarray(T0)
         disp = None
-        for _ in range(max(1, int(n_iter))):
-            disp = self.displacements(T_cur, reducedDOF, root_id, Xi0)
-            if not np.any(disp):
-                return disp, T_cur
-            T_new, _, _ = self.reduce(positions=r0 + disp[:, :3])
-            dT = np.max(np.abs(T_new - T_cur))
-            T_cur = T_new
-            if dT <= atol:
-                break
-        self.reduce()  # restore reference-pose traversal state
+        mutated = False
+        try:
+            for _ in range(max(1, int(n_iter))):
+                disp = self.displacements(T_cur, reducedDOF, root_id, Xi0)
+                if not np.any(disp):
+                    break
+                T_new, _, _ = self.reduce(positions=r0 + disp[:, :3])
+                mutated = True
+                dT = np.max(np.abs(T_new - T_cur))
+                T_cur = T_new
+                if dT <= atol:
+                    break
+        finally:
+            if mutated:
+                self.reduce()  # restore reference-pose traversal state
         return disp, T_cur
 
     def reduce_with_derivative(self):

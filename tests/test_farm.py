@@ -74,3 +74,41 @@ def test_farm_dynamics_runs(farm_model):
     s1 = np.abs(Xi[0, 6, :]).max()
     assert 0.5 < s0 / s1 < 2.0
     assert not np.allclose(Xi[0, 0, :], Xi[0, 6, :])
+
+
+def test_bathymetry_grid(tmp_path):
+    """MoorPy-style bathymetry grid: bilinear depth lookup and its
+    effect on the anchor/grounding classification (the reference feeds
+    the grid to MoorPy at array level, raft_model.py:87-91)."""
+    from raft_tpu.physics.mooring import MooringNetwork, read_bathymetry
+
+    bpath = tmp_path / "bath.txt"
+    bpath.write_text(
+        "--- MoorPy Bathymetry Input File ---\n"
+        "nGridX 3\n"
+        "nGridY 2\n"
+        "      -1000.0 0.0 1000.0\n"
+        "-1000.0  150.0 200.0 250.0\n"
+        " 1000.0  250.0 300.0 350.0\n"
+    )
+    xg, yg, dg = read_bathymetry(str(bpath))
+    assert xg.shape == (3,) and yg.shape == (2,) and dg.shape == (2, 3)
+
+    net = MooringNetwork(200.0, bathymetry=(xg, yg, dg))
+    assert net.depth_at(0.0, -1000.0) == pytest.approx(200.0)
+    assert net.depth_at(1000.0, 1000.0) == pytest.approx(350.0)
+    assert net.depth_at(0.0, 0.0) == pytest.approx(250.0)   # bilinear middle
+    assert net.depth_at(500.0, -1000.0) == pytest.approx(225.0)
+
+    # grounding classification uses the LOCAL depth: an anchor at
+    # z=-200 sits on the seabed where depth=200 but hangs above it
+    # where the seabed is at 350 m
+    a1 = net.add_point(0, [0.0, -1000.0, -199.5])    # local depth 200
+    a2 = net.add_point(0, [1000.0, 1000.0, -199.5])  # local depth 350
+    f1 = net.add_point(1, [0.0, 0.0, 0.0], body=0)
+    f2 = net.add_point(1, [10.0, 0.0, 0.0], body=0)
+    net.add_line(a1, f1, 850.0, 1e3, 7e8)
+    net.add_line(a2, f2, 850.0, 1e3, 7e8)
+    net.finalize()
+    assert bool(net.l_can_ground[0]) is True
+    assert bool(net.l_can_ground[1]) is False
