@@ -47,8 +47,8 @@ def _load():
 def _load_freq(lib):
     if getattr(lib, "_freq_ready", False):
         return
-    lib.panel_solve_frequency.restype = ctypes.c_int
     dbl = lambda: np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+    lib.panel_solve_frequency.restype = ctypes.c_int
     lib.panel_solve_frequency.argtypes = [
         ctypes.c_int, dbl(), dbl(), dbl(), dbl(),             # mesh
         ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
@@ -57,55 +57,91 @@ def _load_freq(lib):
         ctypes.c_int, ctypes.c_int, dbl(), dbl(), dbl(), dbl(),  # tables
         dbl(), dbl(), dbl(),                                  # outputs
     ]
+    lib.panel_solve_frequency_fd.restype = ctypes.c_int
+    lib.panel_solve_frequency_fd.argtypes = [
+        ctypes.c_int, dbl(), dbl(), dbl(), dbl(),             # mesh
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        dbl(),                                                # ref
+        ctypes.c_int, dbl(),                                  # headings
+        ctypes.c_int, ctypes.c_double, dbl(), dbl(),          # modes
+        dbl(), dbl(), dbl(),                                  # outputs
+    ]
     lib._freq_ready = True
 
 
 def solve_bem_frequency(vertices, centroids, normals, areas, omega,
                         headings_rad=(0.0,), depth=np.inf, rho=1025.0,
-                        g=9.81, ref=(0.0, 0.0, 0.0)):
+                        g=9.81, ref=(0.0, 0.0, 0.0), n_modes=512):
     """Radiation + diffraction at one frequency from the native panel
     solver with the free-surface wave Green function.
 
-    The wave term uses the infinite-depth Green function evaluated at
-    the finite-depth wavenumber k0(omega, depth) ('equivalent
-    wavenumber' mapping: the far-field wavelength is exact, the bottom
-    no-flux condition is approximated — good for depth >> draft, the
-    regime of every potMod design in the reference suite).
+    Finite depth with K h = omega^2 depth / g <= 6 solves the TRUE
+    finite-depth problem: John's eigenfunction-series Green function
+    (propagating cosh-profile mode + n_modes evanescent K0 modes,
+    seabed no-flux satisfied exactly; prototype + PV-integral
+    validation in :mod:`raft_tpu.native.green_fd`), with the dispersion
+    roots solved here and passed to the C++ kernel.  For K h > 6 the
+    finite- and infinite-depth kernels agree to ~e^{-2Kh} (< 1e-5) and
+    the tabulated infinite-depth kernel is used at the finite-depth
+    wavenumber — which also keeps the FD series' smooth-remainder
+    small-R treatment inside its validity range k0 R_c << 1.
 
     Returns (A (6,6), B (6,6), X (nh, 6) complex).
     """
-    from raft_tpu.native.green_table import build_tables
-    from raft_tpu.ops.waves import wave_number
-
     lib = _load()
     _load_freq(lib)
-    t = build_tables()
-
-    if np.isfinite(depth):
-        K = float(np.asarray(wave_number(np.asarray([omega]), depth, g=g))[0])
-    else:
-        K = omega * omega / g
 
     n = len(areas)
     nh = len(headings_rad)
     A = np.zeros(36)
     B = np.zeros(36)
     X = np.zeros(nh * 12)
-    rc = lib.panel_solve_frequency(
-        n,
-        np.ascontiguousarray(vertices, dtype=np.float64).reshape(-1),
-        np.ascontiguousarray(centroids, dtype=np.float64).reshape(-1),
-        np.ascontiguousarray(normals, dtype=np.float64).reshape(-1),
-        np.ascontiguousarray(areas, dtype=np.float64),
-        float(K), float(omega), float(rho), float(g),
-        np.ascontiguousarray(ref, dtype=np.float64),
-        nh, np.ascontiguousarray(headings_rad, dtype=np.float64),
-        len(t["lnd"]), len(t["alpha"]),
-        np.ascontiguousarray(t["lnd"]), np.ascontiguousarray(t["alpha"]),
-        np.ascontiguousarray(t["L"]).reshape(-1),
-        np.ascontiguousarray(t["M"]).reshape(-1),
-        A, B, X,
-    )
+
+    Kdeep = omega * omega / g
+    if np.isfinite(depth) and Kdeep * depth <= 6.0:
+        from raft_tpu.native.green_fd import _evan_coeffs, dispersion_roots
+
+        K = omega * omega / g
+        k0, km = dispersion_roots(K, float(depth), int(n_modes))
+        Cm = _evan_coeffs(km, K, float(depth))
+        rc = lib.panel_solve_frequency_fd(
+            n,
+            np.ascontiguousarray(vertices, dtype=np.float64).reshape(-1),
+            np.ascontiguousarray(centroids, dtype=np.float64).reshape(-1),
+            np.ascontiguousarray(normals, dtype=np.float64).reshape(-1),
+            np.ascontiguousarray(areas, dtype=np.float64),
+            float(omega), float(rho), float(g), float(depth),
+            np.ascontiguousarray(ref, dtype=np.float64),
+            nh, np.ascontiguousarray(headings_rad, dtype=np.float64),
+            int(n_modes), float(k0),
+            np.ascontiguousarray(km), np.ascontiguousarray(Cm),
+            A, B, X,
+        )
+    else:
+        from raft_tpu.native.green_table import build_tables
+        from raft_tpu.ops.waves import wave_number
+
+        t = build_tables()
+        if np.isfinite(depth):
+            K = float(np.asarray(wave_number(np.asarray([omega]), depth,
+                                             g=g))[0])
+        else:
+            K = Kdeep
+        rc = lib.panel_solve_frequency(
+            n,
+            np.ascontiguousarray(vertices, dtype=np.float64).reshape(-1),
+            np.ascontiguousarray(centroids, dtype=np.float64).reshape(-1),
+            np.ascontiguousarray(normals, dtype=np.float64).reshape(-1),
+            np.ascontiguousarray(areas, dtype=np.float64),
+            float(K), float(omega), float(rho), float(g),
+            np.ascontiguousarray(ref, dtype=np.float64),
+            nh, np.ascontiguousarray(headings_rad, dtype=np.float64),
+            len(t["lnd"]), len(t["alpha"]),
+            np.ascontiguousarray(t["lnd"]), np.ascontiguousarray(t["alpha"]),
+            np.ascontiguousarray(t["L"]).reshape(-1),
+            np.ascontiguousarray(t["M"]).reshape(-1),
+            A, B, X,
+        )
     if rc != 0:
         raise RuntimeError("panel frequency solve failed (singular system)")
     Xc = X.reshape(nh, 6, 2)

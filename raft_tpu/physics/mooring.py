@@ -54,6 +54,7 @@ class MooringSystem:
     Ca: np.ndarray | None = None      # transverse added mass
     CdAx: np.ndarray | None = None    # tangential drag
     CaAx: np.ndarray | None = None    # tangential added mass
+    BA: np.ndarray | None = None      # internal damping [N-s], <0 = -zeta
     moorMod: int = 0
 
     @property
@@ -76,6 +77,7 @@ def build_mooring(mooring, rho_water=1025.0, g=9.81, x_ref=0.0, y_ref=0.0,
 
     r_anchor, r_fair, L, w, EA = [], [], [], [], []
     m_lin_l, d_l, Cd_l, Ca_l, CdAx_l, CaAx_l = [], [], [], [], [], []
+    BA_sch = []
     for line in mooring["lines"]:
         pA = points[line["endA"]]
         pB = points[line["endB"]]
@@ -98,6 +100,7 @@ def build_mooring(mooring, rho_water=1025.0, g=9.81, x_ref=0.0, y_ref=0.0,
         Ca_l.append(float(coerce(lt, "transverse_added_mass", default=1.0)))
         CdAx_l.append(float(coerce(lt, "tangential_drag", default=0.05)))
         CaAx_l.append(float(coerce(lt, "tangential_added_mass", default=0.0)))
+        BA_sch.append(float(coerce(lt, "damping", default=0.0)))
 
     r_anchor = np.array(r_anchor)
     r_fair = np.array(r_fair)
@@ -122,6 +125,7 @@ def build_mooring(mooring, rho_water=1025.0, g=9.81, x_ref=0.0, y_ref=0.0,
         Ca=np.array(Ca_l),
         CdAx=np.array(CdAx_l),
         CaAx=np.array(CaAx_l),
+        BA=np.array(BA_sch),
         moorMod=int(coerce(mooring, "moorMod", default=0, dtype=int)),
     )
 
@@ -515,11 +519,14 @@ def parse_moordyn_system(path, depth, rho=1025.0, g=9.81, moorMod=0):
                 continue
             up = line.upper()
             if up.startswith("---"):
+                # keep the section matchers IDENTICAL to parse_moordyn's
+                # so the two treatments of the same file never diverge
                 if "LINE TYPE" in up:
                     section = "types"
                 elif "POINT" in up or "CONNECTION" in up:
                     section = "points"
-                elif "- LINES" in up or up.strip("- ").startswith("LINES"):
+                elif up.startswith("---------------------- LINES") \
+                        or "- LINES -" in up or up.strip("- ").startswith("LINES"):
                     section = "lines"
                 else:
                     section = None
@@ -532,6 +539,7 @@ def parse_moordyn_system(path, depth, rho=1025.0, g=9.81, moorMod=0):
                     continue
                 types[toks[0]] = dict(
                     d=d, m=float(toks[2]), EA=float(toks[3]),
+                    BA=float(toks[4]) if len(toks) > 4 else 0.0,
                     Cd=float(toks[6]) if len(toks) > 6 else 1.2,
                     Ca=float(toks[7]) if len(toks) > 7 else 1.0,
                     CdAx=float(toks[8]) if len(toks) > 8 else 0.05,
@@ -554,7 +562,8 @@ def parse_moordyn_system(path, depth, rho=1025.0, g=9.81, moorMod=0):
                               float(toks[4])))
 
     r_anchor, r_fair, L = [], [], []
-    w_l, EA, m_l, d_l, Cd_l, Ca_l, CdAx_l, CaAx_l = [], [], [], [], [], [], [], []
+    w_l, EA, m_l, d_l, Cd_l, Ca_l, CdAx_l, CaAx_l, BA_l = \
+        [], [], [], [], [], [], [], [], []
     for (tname, a, b, length) in lines:
         ka, ra = points[a]
         kb, rb = points[b]
@@ -586,6 +595,7 @@ def parse_moordyn_system(path, depth, rho=1025.0, g=9.81, moorMod=0):
         Ca_l.append(lt["Ca"])
         CdAx_l.append(lt["CdAx"])
         CaAx_l.append(lt["CaAx"])
+        BA_l.append(lt["BA"])
     if not lines:
         raise ValueError("no lines found")
     return MooringSystem(
@@ -593,7 +603,7 @@ def parse_moordyn_system(path, depth, rho=1025.0, g=9.81, moorMod=0):
         L=np.array(L), w=np.array(w_l), EA=np.array(EA), depth=float(depth),
         m_lin=np.array(m_l), d_vol=np.array(d_l), Cd=np.array(Cd_l),
         Ca=np.array(Ca_l), CdAx=np.array(CdAx_l), CaAx=np.array(CaAx_l),
-        moorMod=int(moorMod),
+        BA=np.array(BA_l), moorMod=int(moorMod),
     )
 
 

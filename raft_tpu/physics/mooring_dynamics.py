@@ -102,7 +102,8 @@ def line_static_shape(r_anchor, r_fair, L, w_lin, EA, n_seg=24,
 def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
                   w_arr, k_arr, zeta, beta, depth, rho=1025.0, g=9.81,
                   Cd=1.2, Ca=1.0, CdAx=0.05, CaAx=0.0,
-                  RAO_A=None, RAO_B=None, n_drag_iter=5, s_arc=None):
+                  RAO_A=None, RAO_B=None, n_drag_iter=5, s_arc=None,
+                  BA=0.0):
     """Frequency-domain lumped-mass solve for one line.
 
     r_nodes/T_nodes/grounded/s_arc : static discretisation from
@@ -143,24 +144,38 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
     k_seg = ((EA / l0)[:, None, None] * tt
              + (T_seg / np.maximum(l_seg, 1e-9))[:, None, None] * (I3 - tt))
 
-    # ---- assemble interior stiffness and end-coupling blocks
+    # ---- internal (structural) axial damping per segment, MoorDyn BA
+    # convention: BA >= 0 is the damping coefficient [N-s] (force =
+    # BA * strain rate -> c = BA / l0); BA < 0 means |BA| is the ratio
+    # of critical damping of the segment's axial spring-mass
+    if BA < 0:
+        c_ax = -BA * 2.0 * np.sqrt((EA / l0) * (m_lin * l0))
+    else:
+        c_ax = np.full(n, BA) / l0
+    c_seg = c_ax[:, None, None] * tt
+
+    # ---- assemble interior stiffness/damping and end-coupling blocks
     K = np.zeros((3 * n_int, 3 * n_int))
     K_A = np.zeros((3 * n_int, 3))   # coupling to node 0 (anchor end)
     K_B = np.zeros((3 * n_int, 3))   # coupling to node n (fairlead end)
+    C = np.zeros((3 * n_int, 3 * n_int))
+    C_A = np.zeros((3 * n_int, 3))
+    C_B = np.zeros((3 * n_int, 3))
     for si in range(n):
         iL, iR = si - 1, si          # interior indices of segment ends
-        k = k_seg[si]
-        if 0 <= iL < n_int:
-            K[3 * iL:3 * iL + 3, 3 * iL:3 * iL + 3] += k
-        if 0 <= iR < n_int:
-            K[3 * iR:3 * iR + 3, 3 * iR:3 * iR + 3] += k
-        if 0 <= iL < n_int and 0 <= iR < n_int:
-            K[3 * iL:3 * iL + 3, 3 * iR:3 * iR + 3] -= k
-            K[3 * iR:3 * iR + 3, 3 * iL:3 * iL + 3] -= k
-        if iL == -1 and 0 <= iR < n_int:
-            K_A[3 * iR:3 * iR + 3] -= k
-        if iR == n - 1 and 0 <= iL < n_int:
-            K_B[3 * iL:3 * iL + 3] -= k
+        for mat, matA, matB, k in ((K, K_A, K_B, k_seg[si]),
+                                   (C, C_A, C_B, c_seg[si])):
+            if 0 <= iL < n_int:
+                mat[3 * iL:3 * iL + 3, 3 * iL:3 * iL + 3] += k
+            if 0 <= iR < n_int:
+                mat[3 * iR:3 * iR + 3, 3 * iR:3 * iR + 3] += k
+            if 0 <= iL < n_int and 0 <= iR < n_int:
+                mat[3 * iL:3 * iL + 3, 3 * iR:3 * iR + 3] -= k
+                mat[3 * iR:3 * iR + 3, 3 * iL:3 * iL + 3] -= k
+            if iL == -1 and 0 <= iR < n_int:
+                matA[3 * iR:3 * iR + 3] -= k
+            if iR == n - 1 and 0 <= iL < n_int:
+                matB[3 * iL:3 * iL + 3] -= k
 
     # ---- nodal mass + added mass (node tangent = mean of segments)
     t_node = np.zeros((n + 1, 3))
@@ -196,8 +211,11 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
 
     K_j = jnp.asarray(K)
     M_j = jnp.asarray(M)
+    C_j = jnp.asarray(C)
     K_A_j = jnp.asarray(K_A)
     K_B_j = jnp.asarray(K_B)
+    C_A_j = jnp.asarray(C_A)
+    C_B_j = jnp.asarray(C_B)
     clamp_j = jnp.asarray(clamp)
 
     # Morison inertial excitation on interior nodes
@@ -221,8 +239,12 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
         Bfull = block_diag(Bn)
         F_drag = jnp.einsum("nij,njw->niw", Bn, u[1:-1])
         F = (F_in + F_drag).transpose(2, 0, 1).reshape(nw, 3 * n_int)
-        F = F - jnp.einsum("ij,jw->wi", K_A_j, XA) - jnp.einsum("ij,jw->wi", K_B_j, XB)
-        D = (K_j[None] + 1j * w_arr[:, None, None] * Bfull[None]
+        iwc = 1j * w_arr[:, None]
+        F = (F - jnp.einsum("ij,jw->wi", K_A_j, XA)
+             - jnp.einsum("ij,jw->wi", K_B_j, XB)
+             - iwc * jnp.einsum("ij,jw->wi", C_A_j, XA)
+             - iwc * jnp.einsum("ij,jw->wi", C_B_j, XB))
+        D = (K_j[None] + 1j * w_arr[:, None, None] * (Bfull + C_j)[None]
              - (w_arr**2)[:, None, None] * M_j[None]).astype(complex)
         # clamped dofs: identity rows/cols, zero rhs
         idx = jnp.where(clamp_j, 1.0, 0.0)
@@ -252,8 +274,11 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
     Xn = X.reshape(nw, n_int, 3).transpose(1, 2, 0)       # (n_int, 3, nw)
     X_all = jnp.concatenate([XA[None], Xn, XB[None]], axis=0)  # (n+1,3,nw)
     dX = X_all[1:] - X_all[:-1]
-    T_amp_seg = jnp.asarray(EA / l0)[:, None] * jnp.einsum(
-        "si,siw->sw", jnp.asarray(t_seg), dX)
+    # axial tension incl. the internal-damping contribution
+    # T = EA*strain + c_ax*l0*strain_rate
+    T_amp_seg = (jnp.asarray(EA / l0)[:, None]
+                 + 1j * w_arr[None, :] * jnp.asarray(c_ax)[:, None]) * \
+        jnp.einsum("si,siw->sw", jnp.asarray(t_seg), dX)
     T_amp = jnp.concatenate([
         T_amp_seg[:1], 0.5 * (T_amp_seg[1:] + T_amp_seg[:-1]), T_amp_seg[-1:]
     ], axis=0)  # (n+1, nw)
@@ -261,18 +286,22 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
     # ---- condensed fairlead impedance Z(w): force at end B per unit
     # end-B motion with the interior dynamically condensed out
     Bfull = block_diag(Bn)
-    D = (K_j[None] + 1j * w_arr[:, None, None] * Bfull[None]
+    D = (K_j[None] + 1j * w_arr[:, None, None] * (Bfull + C_j)[None]
          - (w_arr**2)[:, None, None] * M_j[None]).astype(complex)
     idx = jnp.where(clamp_j, 1.0, 0.0)
     D = D * (1 - idx[None, :, None]) * (1 - idx[None, None, :])
     D = D + jnp.eye(3 * n_int)[None] * idx[None, :]
-    K_B_m = K_B_j * (1 - idx[:, None])
-    # K_bb at the fairlead: last segment stiffness (+ half-node mass)
+    # frequency-dependent end coupling incl. structural damping
+    KC_B = (K_B_j[None] + 1j * w_arr[:, None, None] * C_B_j[None]) \
+        * (1 - idx[None, :, None])
+    # K_bb at the fairlead: last segment stiffness/damping (+ half node mass)
     K_bb = jnp.asarray(k_seg[-1])
+    C_bb = jnp.asarray(c_seg[-1])
     M_bb = jnp.asarray(M_node[-1]) * 0.5
-    Dinv_KB = jnp.linalg.solve(D, jnp.broadcast_to(K_B_m, (nw,) + K_B_m.shape))
-    Z_fair = (K_bb[None] - (w_arr**2)[:, None, None] * M_bb[None]
-              - jnp.einsum("ij,wjk->wik", K_B_m.T, Dinv_KB))
+    Dinv_KB = jnp.linalg.solve(D, KC_B)
+    Z_fair = (K_bb[None] + 1j * w_arr[:, None, None] * C_bb[None]
+              - (w_arr**2)[:, None, None] * M_bb[None]
+              - jnp.einsum("wij,wjk->wik", jnp.swapaxes(KC_B, 1, 2), Dinv_KB))
     return dict(T_amp=T_amp, Z_fair=Z_fair, X=Xn)
 
 
@@ -310,6 +339,7 @@ def fowt_line_tension_amps(ms, r6, Xi_PRP, w_arr, k_arr, S, beta, depth,
             w_np, np.asarray(k_arr), zeta, float(beta), depth, rho=rho, g=g,
             Cd=float(ms.Cd[il]), Ca=float(ms.Ca[il]),
             CdAx=float(ms.CdAx[il]), CaAx=float(ms.CaAx[il]),
+            BA=float(ms.BA[il]) if ms.BA is not None else 0.0,
             RAO_A=None, RAO_B=np.asarray(dr), s_arc=s_arc)
         out[il] = np.asarray(res["T_amp"][0])
         out[il + nL] = np.asarray(res["T_amp"][-1])
@@ -341,7 +371,8 @@ def fowt_mooring_impedance(ms, r6, w_arr, k_arr, S, beta, depth,
             float(ms.m_lin[il]), float(ms.d_vol[il]),
             w_np, np.asarray(k_arr), zeta, float(beta), depth, rho=rho, g=g,
             Cd=float(ms.Cd[il]), Ca=float(ms.Ca[il]),
-            CdAx=float(ms.CdAx[il]), CaAx=float(ms.CaAx[il]), s_arc=s_arc)
+            CdAx=float(ms.CdAx[il]), CaAx=float(ms.CaAx[il]),
+            BA=float(ms.BA[il]) if ms.BA is not None else 0.0, s_arc=s_arc)
         Zf = res["Z_fair"]                       # (nw, 3, 3)
         lever = jnp.asarray(r_fair - np.asarray(r6[:3]))
         H = skew(lever)                          # Hv = cross(v, lever)

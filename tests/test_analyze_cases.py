@@ -20,13 +20,15 @@ Known golden anomalies (measured, documented rather than hidden):
   slack-line tension std differs ~30%, with the discrepancy growing
   with frequency like a line-inertia term.  Tension spectra are
   therefore gated loosely for the wind case.
-* The VolturnUS-S goldens embed a ~1.2e5 N mean surge force in the
-  no-wind case (surge_avg 1.61 m vs 0.43 m) inconsistent with the
-  reference's own hardcoded solveStatics target for the same design
-  (tests/test_model.py wave case, which we match to 1e-8) — consistent
-  with a wave-mean-drift term from a potSecOrder>0 configuration no
-  longer in the shipped YAML.  VolturnUS analyzeCases parity is
-  covered through the statics targets + per-stage goldens instead.
+* RESOLVED (round 4): the VolturnUS-S goldens' ~1.2e5 N mean surge
+  force in the no-wind case is the slender-body-QTF mean drift fed back
+  into the equilibrium — the reference re-runs solveStatics with
+  Fhydro_2nd_mean for ANY potSecOrder > 0 (raft_model.py:316-328), and
+  with the same feedback our means match at ~1%
+  (test_analyze_cases_volturn_meandrift).  The VolturnUS WIND case
+  remains off in the low-frequency 2nd-order band (motion-dependent
+  QTF terms with wind-included RAOs; deviations up to ~0.9 of the tiny
+  yaw channel) and stays out of the gated set for now.
 """
 
 import os
@@ -77,9 +79,12 @@ def test_analyze_cases_oc3_nowind():
     assert model.cases[iCase]["wind_speed"] > 0
     mc = res["case_metrics"][iCase][0]
     gc = true["case_metrics"][iCase][0]
-    # mean offsets carry the mean rotor thrust through the equilibrium
+    # mean offsets carry the mean rotor thrust through the equilibrium;
+    # gate covers the reference's own 0.05 m solveStatics tolerance on
+    # the ~28 m offset (turbine constants at the case-start zero pose,
+    # raft_model.py:602, shift the converged mean by ~7 mm)
     assert_allclose(float(np.asarray(mc["surge_avg"])),
-                    float(np.asarray(gc["surge_avg"])), rtol=2e-4)
+                    float(np.asarray(gc["surge_avg"])), rtol=2e-3)
     assert_allclose(float(np.asarray(mc["pitch_avg"])),
                     float(np.asarray(gc["pitch_avg"])), rtol=2e-3)
     # motion spectra: aero damping folds the ~1% BEMT derivative
@@ -97,3 +102,107 @@ def test_analyze_cases_oc3_nowind():
     a = np.asarray(mc["Tmoor_PSD"])
     b = np.asarray(gc["Tmoor_PSD"])
     assert np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-12) < 0.5
+
+
+def test_analyze_cases_flexible_wind():
+    """VolturnUS-S-flexible analyzeCases parity — BOTH cases, including
+    the 10 m/s operating-turbine case through the aero-servo chain on a
+    flexible-tower (multibody) model.
+
+    Measured deviations (f64 CPU): case 0 motion PSDs ~2e-10 (golden
+    level), Tmoor 1.2e-4; case 1 motion PSDs 4-5e-3 (the ~1% BEMT
+    derivative deviation through the aero damping), AxRNA 1.1e-2,
+    Tmoor 2e-2.  Gates at ~1.5x measured.  Mbase (FE tower-base moment)
+    is gated loosely: the load recovery -Kf @ Xi is a near-cancellation
+    that amplifies the small flexible-DOF response deviations (case 0
+    3.4e-2 with motions at 1e-10; case 1 ~0.53 via the wind-band
+    flexible response — the aero damping's effect on the tower-mode
+    rows, invisible in the platform-motion channels).
+    """
+    path = ref_data("VolturnUS-S-flexible.yaml")
+    if not os.path.exists(path):
+        pytest.skip("reference data unavailable")
+    model = raft_tpu.Model(path)
+    res = model.analyze_cases()
+    with open(path.replace(".yaml", "_true_analyzeCases.pkl"), "rb") as f:
+        true = pickle.load(f)
+
+    mc = res["case_metrics"][0][0]
+    gc = true["case_metrics"][0][0]
+    for metric in ("surge_PSD", "heave_PSD", "pitch_PSD", "yaw_PSD",
+                   "AxRNA_PSD"):
+        a, b = np.asarray(mc[metric]), np.asarray(gc[metric])
+        assert np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-12) < 1e-8, metric
+    a, b = np.asarray(mc["Tmoor_PSD"]), np.asarray(gc["Tmoor_PSD"])
+    assert np.max(np.abs(a - b)) / np.max(np.abs(b)) < 1e-3
+    a, b = np.asarray(mc["Mbase_PSD"]), np.asarray(gc["Mbase_PSD"])
+    assert np.max(np.abs(a - b)) / np.max(np.abs(b)) < 8e-2
+
+    mc = res["case_metrics"][1][0]
+    gc = true["case_metrics"][1][0]
+    assert model.cases[1]["wind_speed"] > 0
+    assert_allclose(float(np.asarray(mc["surge_avg"])),
+                    float(np.asarray(gc["surge_avg"])), rtol=1e-2)
+    assert_allclose(float(np.asarray(mc["pitch_avg"])),
+                    float(np.asarray(gc["pitch_avg"])), rtol=5e-2)
+    for metric, gate in (("surge_PSD", 1e-2), ("heave_PSD", 1e-2),
+                         ("pitch_PSD", 1e-2), ("AxRNA_PSD", 2e-2),
+                         ("Tmoor_PSD", 3e-2), ("Mbase_PSD", 0.6)):
+        a, b = np.asarray(mc[metric]), np.asarray(gc[metric])
+        assert np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-12) < gate, metric
+
+
+def test_analyze_cases_farm_wind():
+    """2-unit VolturnUS-S farm analyzeCases parity at 10.5 m/s operating
+    wind — the coupled array chain (shared-mooring equilibrium, per-unit
+    aero + excitation, block system impedance) against the farm golden.
+
+    Measured deviations (f64 CPU): motion PSDs 1e-4..1.6e-2 per unit,
+    Mbase 2.1-3.8e-2, surge_avg 4e-3.  Gates at ~1.5x measured.
+    """
+    path = ref_data("VolturnUS-S_farm.yaml")
+    if not os.path.exists(path):
+        pytest.skip("reference data unavailable")
+    model = raft_tpu.Model(path)
+    res = model.analyze_cases()
+    with open(path.replace(".yaml", "_true_analyzeCases.pkl"), "rb") as f:
+        true = pickle.load(f)
+    assert np.asarray(model.cases[0]["wind_speed"]).max() > 0
+    for ifowt in range(2):
+        mc = res["case_metrics"][0][ifowt]
+        gc = true["case_metrics"][0][ifowt]
+        assert_allclose(float(np.asarray(mc["surge_avg"])),
+                        float(np.asarray(gc["surge_avg"])), rtol=1e-2)
+        for metric, gate in (("surge_PSD", 3e-3), ("heave_PSD", 1e-3),
+                             ("pitch_PSD", 2.5e-2), ("AxRNA_PSD", 2e-2),
+                             ("Mbase_PSD", 6e-2)):
+            a, b = np.asarray(mc[metric]), np.asarray(gc[metric])
+            assert np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-12) < gate, \
+                (ifowt, metric)
+
+
+def test_analyze_cases_volturn_meandrift():
+    """VolturnUS-S analyzeCases no-wind case with the slender-QTF mean
+    drift fed back into the equilibrium (raft_model.py:316-328): the
+    golden's 1.61 m mean surge — formerly documented as an anomaly — is
+    the drift-included pose.  Motion/tension PSDs include the 2nd-order
+    response realisation (measured 1.2-2.6e-2)."""
+    path = ref_data("VolturnUS-S.yaml")
+    if not os.path.exists(path):
+        pytest.skip("reference data unavailable")
+    model = raft_tpu.Model(path)
+    res = model.analyze_cases()
+    with open(path.replace(".yaml", "_true_analyzeCases.pkl"), "rb") as f:
+        true = pickle.load(f)
+    mc = res["case_metrics"][0][0]
+    gc = true["case_metrics"][0][0]
+    assert model.cases[0]["wind_speed"] == 0
+    assert_allclose(float(np.asarray(mc["surge_avg"])),
+                    float(np.asarray(gc["surge_avg"])), rtol=2e-2)
+    assert_allclose(float(np.asarray(mc["pitch_avg"])),
+                    float(np.asarray(gc["pitch_avg"])), rtol=1e-2)
+    for metric, gate in (("surge_PSD", 2e-2), ("heave_PSD", 2e-2),
+                         ("pitch_PSD", 4e-2), ("AxRNA_PSD", 2e-2),
+                         ("Mbase_PSD", 3e-2), ("Tmoor_PSD", 2e-2)):
+        a, b = np.asarray(mc[metric]), np.asarray(gc[metric])
+        assert np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-12) < gate, metric
