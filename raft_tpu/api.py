@@ -203,6 +203,17 @@ def _hydro_force_2nd_traced(Qm, heads_rad, beta, S0, dw):
     return f_mean, f_out.T
 
 
+def _lagrange3(vals, s_nodes, s):
+    """Quadratic Lagrange interpolation of stacked sample arrays
+    vals (3, ...) at traced scalar s."""
+    s0, s1, s2 = (float(x) for x in s_nodes)
+    l0 = (s - s1) * (s - s2) / ((s0 - s1) * (s0 - s2))
+    l1 = (s - s0) * (s - s2) / ((s1 - s0) * (s1 - s2))
+    l2 = (s - s0) * (s - s1) / ((s2 - s0) * (s2 - s1))
+    v = jnp.asarray(vals)
+    return l0 * v[0] + l1 * v[1] + l2 * v[2]
+
+
 def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
     """Build the FULL-PHYSICS traced case evaluator for a single-FOWT
     model: aero-servo constants + gyroscopics, potential-flow A/B/X,
@@ -236,8 +247,11 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
     and ONE compilation serves an entire geometry DoE — differentiable
     end-to-end (``jax.grad`` of any response metric wrt any geometry
     parameter via the implicit-function-theorem fixed points).
-    Potential-flow coefficients (absent on the strip-theory flagship
-    designs) are not re-solved under geometry scaling.
+    Potential-flow designs (native-solver potMod members) get their
+    A/B/X coefficients from a per-evaluator 3-point diameter-scale
+    sampling of the native BEM solver, entering the trace as a
+    quadratic interpolation in the scalar ``d_scale`` — the traced
+    analogue of the WEIS loop re-running HAMS per design iteration.
     """
     import dataclasses
 
@@ -273,10 +287,35 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
         A_BEM[:6, :6, :] = bem["A_BEM"]
         B_BEM[:6, :6, :] = bem["B_BEM"]
     has_X = bem is not None and np.any(np.abs(bem["X_BEM"]) > 0)
+    # geometry axis for potential-flow designs: the WEIS loop re-runs
+    # HAMS per design iteration (raft_model.py:1509 preprocess_HAMS,
+    # omdao_raft.py member d/t inputs); here the native solver runs at
+    # a few diameter scales ONCE and the coefficients enter the trace
+    # as a quadratic interpolation in the (scalar) d_scale — so the
+    # geometry DoE stays one compiled evaluator (validity test:
+    # tests/test_geometry_axis.py::test_geometry_bem_interpolation)
+    bem_samples = None
     if geometry and bem is not None:
-        raise ValueError(
-            "geometry tracing requires strip-theory-only designs: "
-            "potential-flow coefficients are not re-solved per geometry")
+        if fs.potFirstOrder == 1 and fs.hydroPath:
+            raise ValueError(
+                "geometry tracing with potential flow needs the NATIVE "
+                "solver (file-loaded WAMIT coefficients cannot be "
+                "re-solved per geometry)")
+        settings = model.design.get("settings", {}) or {}
+        scales = tuple(float(s) for s in settings.get(
+            "bem_geom_scales", (0.92, 1.0, 1.08)))
+        if len(scales) != 3:
+            raise ValueError("bem_geom_scales: exactly 3 sample scales; "
+                             "d_scale should stay inside their span "
+                             "(the quadratic fit extrapolates beyond it)")
+        bems = [bem if abs(s - 1.0) < 1e-12 else model.run_bem(d_scale=s)
+                for s in scales]
+        bem_samples = dict(
+            s=np.asarray(scales),
+            A=np.stack([np.asarray(b["A_BEM"]) for b in bems]),
+            B=np.stack([np.asarray(b["B_BEM"]) for b in bems]),
+            X=np.stack([np.asarray(b["X_BEM"]) for b in bems]),
+        )
 
     # external difference-frequency QTF on the model grid
     qtf = model.qtf
@@ -309,7 +348,7 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
                 L=jnp.asarray(ms.L) * geom.get("L_moor_scale", 1.0),
                 EA=jnp.asarray(ms.EA) * geom.get("EA_moor_scale", 1.0),
             )
-        return dict(
+        out = dict(
             ss=ss_t, ms=ms_t,
             K_h=stat_t["C_struc"] + stat_t["C_hydro"],
             C_elast=stat_t["C_elast"],
@@ -318,6 +357,18 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
             A_hydro=A_hydro_t,
             hc0=dict(hc0_t, A_hydro=A_hydro_t),
         )
+        if bem_samples is not None:
+            gs = jnp.asarray(geom.get("d_scale", 1.0), dtype=float)
+            if gs.ndim != 0:
+                raise ValueError(
+                    "potential-flow geometry interpolation supports a "
+                    "SCALAR d_scale (one uniform diameter scale); keep it "
+                    "inside the bem_geom_scales span — the quadratic fit "
+                    "extrapolates beyond it")
+            out["A_BEM6"] = _lagrange3(bem_samples["A"], bem_samples["s"], gs)
+            out["B_BEM6"] = _lagrange3(bem_samples["B"], bem_samples["s"], gs)
+            out["X_BEM6"] = _lagrange3(bem_samples["X"], bem_samples["s"], gs)
+        return out
 
     def evaluate(case):
         wind_speed = case.get("wind_speed", 0.0)
@@ -336,6 +387,7 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
         ss_t, ms_t = ss, ms
         K_h_t, C_elast_t, F_und_t = K_h, C_elast, F_und
         M_struc_t, A_hydro_t, hc0_t = M_struc, A_hydro, hc0
+        A_BEM_t, B_BEM_t, X_BEM_t = A_BEM, B_BEM, None
         if geometry:
             gc = case.get("geom_const")
             if gc is None:
@@ -343,6 +395,12 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
             ss_t, ms_t = gc["ss"], gc["ms"]
             K_h_t, C_elast_t, F_und_t = gc["K_h"], gc["C_elast"], gc["F_und"]
             M_struc_t, A_hydro_t, hc0_t = gc["M_struc"], gc["A_hydro"], gc["hc0"]
+            if "A_BEM6" in gc:
+                A_BEM_t = jnp.zeros((nDOF, nDOF, nw)).at[:6, :6, :].set(
+                    gc["A_BEM6"])
+                B_BEM_t = jnp.zeros((nDOF, nDOF, nw)).at[:6, :6, :].set(
+                    gc["B_BEM6"])
+                X_BEM_t = gc["X_BEM6"]
 
         # ---- aero-servo constants about the rotor nodes (zero-pose Tn,
         # matching the reference's calcTurbineConstants-at-case-start)
@@ -410,12 +468,14 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
 
         F_BEM = jnp.zeros((nWaves, nDOF, nw), dtype=complex)
         if has_X:
+            X_tab = bem["X_BEM"] if X_BEM_t is None else X_BEM_t
+
             def bem_one(bd):
                 phase = jnp.exp(-1j * k * (
                     fs.x_ref * jnp.cos(jnp.deg2rad(bd))
                     + fs.y_ref * jnp.sin(jnp.deg2rad(bd))))
                 X = _interp_heading_traced(
-                    bem["X_BEM"], bem["headings"], (bd - fs.heading_adjust) % 360)
+                    X_tab, bem["headings"], (bd - fs.heading_adjust) % 360)
                 return X * phase
             F_BEM = F_BEM.at[:, :6, :].set(
                 jax.vmap(bem_one)(beta_deg) * zeta[:, None, :])
@@ -434,8 +494,8 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
         C_moor = jnp.zeros((nDOF, nDOF))
         if ms is not None:
             C_moor = C_moor.at[:6, :6].add(mooring_stiffness(ms_t, X0[:6]))
-        M_lin = A_aero + (M_struc_t + A_hydro_t)[:, :, None] + jnp.asarray(A_BEM)
-        B_lin = B_aero + jnp.asarray(B_BEM) + B_gyro[:, :, None]
+        M_lin = A_aero + (M_struc_t + A_hydro_t)[:, :, None] + jnp.asarray(A_BEM_t)
+        B_lin = B_aero + jnp.asarray(B_BEM_t) + B_gyro[:, :, None]
         C_lin = jnp.asarray(K_h_t) + C_moor + jnp.asarray(C_elast_t)
         F_lin = F_BEM[0] + exc["F_hydro_iner"][0] + F_2nd[0]
 
@@ -666,7 +726,48 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
     return evaluate
 
 
-def make_flexible_evaluator(model, nWaves=1, turb_static=None):
+def flexible_struct_params(model):
+    """Geometry-dependent structural parameter pytree of a flexible
+    model, for the ``make_flexible_evaluator`` geometry axis: every
+    baked constant that changes under member d/t/ballast/mooring
+    scaling (statics matrices incl. the FE-beam C_elast, zero-pose
+    hydro-constant tensors, strip coefficient tables, mooring L/EA).
+    Station LAYOUT (node positions, topology schedules, strip counts)
+    is geometry-static, so pytrees from models rebuilt at different
+    member scales share one structure — and therefore ONE compiled
+    evaluator (the flexible analogue of the rigid traced geometry
+    axis; the host rebuild replaces the in-trace FE re-derivation,
+    trading differentiability for exact build parity)."""
+    fs = model.fowtList[0]
+    fh = model.hydro[0]
+    stat = model.statics()
+    ss = fh.strips
+    ms = model.ms
+    out = dict(
+        K_h=np.asarray(stat["C_struc"] + stat["C_hydro"]),
+        C_elast=np.asarray(stat["C_elast"]),
+        F_und=np.asarray(stat["W_struc"] + stat["W_hydro"]
+                         + stat["f0_additional"]),
+        M_struc=np.asarray(stat["M_struc"]),
+        hc0={kk: np.asarray(fh.hc0[kk])
+             for kk in ("A_hydro", "Amat", "Imat", "a_i")},
+        ss=dict(
+            ds=np.asarray(ss.ds), drs=np.asarray(ss.drs),
+            Cd_q=np.asarray(ss.Cd_q), Cd_p1=np.asarray(ss.Cd_p1),
+            Cd_p2=np.asarray(ss.Cd_p2), Cd_End=np.asarray(ss.Cd_End),
+            Ca_q=np.asarray(ss.Ca_q), Ca_p1=np.asarray(ss.Ca_p1),
+            Ca_p2=np.asarray(ss.Ca_p2), Ca_End=np.asarray(ss.Ca_End),
+            Cm_p1_w=np.asarray(ss.Cm_p1_w), Cm_p2_w=np.asarray(ss.Cm_p2_w),
+        ),
+    )
+    if ms is not None:
+        out["ms"] = dict(L=np.asarray(ms.L), EA=np.asarray(ms.EA),
+                         w=np.asarray(ms.w))
+    return out
+
+
+def make_flexible_evaluator(model, nWaves=1, turb_static=None,
+                            geometry=False):
     """FULL-PHYSICS traced case evaluator for a flexible/multibody
     single-FOWT model (reduced N-DOF structures, e.g. the 150-DOF
     VolturnUS-S-flexible): the displaced-pose node kinematics and the
@@ -681,6 +782,13 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None):
 
     Parity vs the orchestrated path is gated at 1e-9
     (tests/test_flexible_evaluator.py).
+
+    geometry=True enables the flexible GEOMETRY design axis:
+    ``case["struct_params"]`` (a :func:`flexible_struct_params` pytree,
+    host-rebuilt per design sample — the flexible FE constants come
+    from the exact build path rather than a traced twin) overrides all
+    geometry-dependent baked constants, so one compiled evaluator
+    serves a design DoE by vmapping over stacked parameter pytrees.
     """
     fs = model.fowtList[0]
     assert model.nFOWT == 1, "single-FOWT flexible evaluator"
@@ -730,6 +838,27 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None):
         beta_deg = jnp.atleast_1d(jnp.asarray(case.get("beta_deg", 0.0)) * jnp.ones(nWaves))
         beta = jnp.deg2rad(beta_deg)
 
+        # ---- flexible geometry axis: traced structural parameters
+        # override the baked constants (see docstring)
+        ss_t, ms_t = ss, ms
+        K_h_t, C_elast_t, F_und_t = K_h, C_elast, F_und
+        M_struc_t, hc0_t = M_struc, hc0
+        force_t, stiff_t = force, stiff
+        if geometry and case.get("struct_params") is not None:
+            import dataclasses as _dc
+
+            sp = case["struct_params"]
+            K_h_t, C_elast_t = sp["K_h"], sp["C_elast"]
+            F_und_t, M_struc_t = sp["F_und"], sp["M_struc"]
+            hc0_t = dict(hc0, **sp["hc0"])
+            ss_t = _dc.replace(
+                ss, **{kk: jnp.asarray(v) for kk, v in sp["ss"].items()})
+            if ms is not None and "ms" in sp:
+                ms_t = _dc.replace(ms, L=jnp.asarray(sp["ms"]["L"]),
+                                   EA=jnp.asarray(sp["ms"]["EA"]),
+                                   w=jnp.asarray(sp["ms"]["w"]))
+                force_t, stiff_t = single_ms_closures(ms_t, nDOF)
+
         # ---- aero-servo constants (zero-pose rotor-node T rows, the
         # reference's calcTurbineConstants-at-case-start)
         f_aero0 = jnp.zeros(nDOF)
@@ -756,44 +885,45 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None):
             B_gyro = B_gyro + on * (Tn_n.T @ Bg @ Tn_n)
 
         F_current = morison.current_loads(
-            fs, ss, hc0, cur_speed, cur_heading,
+            fs, ss_t, hc0_t, cur_speed, cur_heading,
             min([r.Zhub for r in fs.rotors if r.Zhub < 0], default=0.0),
             Tn0, jnp.asarray(fs.node_r0))
 
         # ---- equilibrium
         F_env = F_current + f_aero0
         X0, _ = solve_equilibrium_general(
-            jnp.asarray(K_h), jnp.asarray(F_und), F_env, force, stiff,
-            tol_vec, caps, refs, C_elast=jnp.asarray(C_elast))
+            jnp.asarray(K_h_t), jnp.asarray(F_und_t), F_env, force_t, stiff_t,
+            tol_vec, caps, refs, C_elast=jnp.asarray(C_elast_t))
 
         # ---- traced displaced-pose kinematics (nonlinear rigid-link /
         # beam-chain node displacements + position-dependent T)
         r_nodes, node_rot, Tn = tt.kinematics(X0)
         r, q, p1, p2 = morison.strip_frames(
-            ss, jnp.eye(3), r_nodes, node_rot=node_rot)
+            ss_t, jnp.eye(3), r_nodes, node_rot=node_rot)
         sub = r[:, 2] < 0
-        hc = dict(hc0, r=r, q=q, p1=p1, p2=p2, sub=sub,
-                  active=sub & jnp.asarray(ss.active))
+        hc = dict(hc0_t, r=r, q=q, p1=p1, p2=p2, sub=sub,
+                  active=sub & jnp.asarray(ss_t.active))
 
         # ---- excitation + drag-linearised N-DOF impedance solve
         S = jax.vmap(lambda h, t, g_: wv.jonswap(w, h, t, gamma=g_))(Hs, Tp, gamma)
         zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
-        exc = morison.hydro_excitation(fs, ss, hc, zeta, beta, w, k, Tn, r_nodes)
+        exc = morison.hydro_excitation(fs, ss_t, hc, zeta, beta, w, k, Tn, r_nodes)
 
         C_moor = jnp.zeros((nDOF, nDOF))
         if ms is not None:
-            C_moor = C_moor.at[:6, :6].add(mooring_stiffness(ms, X0[:6]))
-        M_lin = A_aero + (jnp.asarray(M_struc) + jnp.asarray(A_hydro))[:, :, None]
+            C_moor = C_moor.at[:6, :6].add(mooring_stiffness(ms_t, X0[:6]))
+        M_lin = A_aero + (jnp.asarray(M_struc_t)
+                          + jnp.asarray(hc0_t["A_hydro"]))[:, :, None]
         B_lin = B_aero + B_gyro[:, :, None]
-        C_lin = jnp.asarray(K_h) + C_moor + jnp.asarray(C_elast)
+        C_lin = jnp.asarray(K_h_t) + C_moor + jnp.asarray(C_elast_t)
         F_lin = exc["F_hydro_iner"][0]
 
         Z, _, Bmat, dyn_diag = solve_dynamics_fowt(
-            fs, ss, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
+            fs, ss_t, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
             w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart)
 
         def fwave_one(ih):
-            F_drag = morison.drag_excitation(fs, ss, hc, Bmat, exc["u"][ih],
+            F_drag = morison.drag_excitation(fs, ss_t, hc, Bmat, exc["u"][ih],
                                              Tn, r_nodes)
             return exc["F_hydro_iner"][ih] + F_drag
         F_waves = jnp.stack([fwave_one(ih) for ih in range(nWaves)])

@@ -591,7 +591,8 @@ class Model:
         return self._bem_list
 
     def run_bem(self, ifowt=0, w_bem=None, headings=None, save_dir=None,
-                n_az=None, dz_max=None, force=False, workers=None):
+                n_az=None, dz_max=None, force=False, workers=None,
+                d_scale=1.0):
         """Run the native free-surface panel solver on the FOWT's potMod
         members and read the coefficients back through the WAMIT
         interchange files (mirrors the reference's HAMS round trip:
@@ -599,6 +600,9 @@ class Model:
 
         Results are cached in ``save_dir`` (default
         ``./_bem_cache/<design name>``); pass force=True to re-run.
+        ``d_scale`` uniformly scales the potMod members' diameters/side
+        lengths before meshing (the geometry design axis; the cache key
+        includes the scaled mesh, so each scale gets its own entry).
         Returns the same dict structure as WAMIT-file loading.
         """
         import os
@@ -634,10 +638,24 @@ class Model:
 
         n_az_v = n_az or int(coerce(settings, "nAz_BEM", default=18, dtype=int))
         dz_v = dz_max or (coerce(settings, "dz_BEM", default=0.0) or None)
-        v, c, nrm, a = mesh_fowt(fs, dz_max=dz_v, n_az=n_az_v)
+        fs_mesh = fs
+        if abs(float(d_scale) - 1.0) > 1e-12:
+            import copy as _copy
+            import dataclasses as _dc
+
+            fs_mesh = _copy.copy(fs)
+            fs_mesh.members = [
+                _dc.replace(m, d=np.asarray(m.d) * float(d_scale))
+                if m.potMod else m
+                for m in fs.members
+            ]
+        v, c, nrm, a = mesh_fowt(fs_mesh, dz_max=dz_v, n_az=n_az_v)
         if len(a) == 0:
             return None
         hsh = hashlib.sha256()
+        # kernel-version token: cache entries from older solver kernels
+        # (e.g. pre-finite-depth) must not be served for the same inputs
+        hsh.update(b"panel_bem-v2-fd")
         for arr in (v, a, np.asarray(w_bem, float),
                     np.asarray(headings, float),
                     np.asarray([self.depth, fs.rho_water, fs.g], float)):

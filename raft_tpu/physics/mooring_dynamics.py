@@ -146,8 +146,12 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
 
     # ---- internal (structural) axial damping per segment, MoorDyn BA
     # convention: BA >= 0 is the damping coefficient [N-s] (force =
-    # BA * strain rate -> c = BA / l0); BA < 0 means |BA| is the ratio
-    # of critical damping of the segment's axial spring-mass
+    # BA * strain rate -> c = BA / l0); BA < 0 means |BA| is a ratio of
+    # critical damping, realised here as the segment spring-mass
+    # critical damping 2 sqrt(k m) (MoorDyn's exact per-segment
+    # constant is not verifiable in this image — MoorPy/MoorDyn sources
+    # absent; a factor-level difference only shifts the already
+    # heavily-damped axial mode)
     if BA < 0:
         c_ax = -BA * 2.0 * np.sqrt((EA / l0) * (m_lin * l0))
     else:
