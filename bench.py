@@ -610,9 +610,9 @@ def run_mode(mode):
     # that returns only (a scalar reduction of) an intermediate lets XLA
     # prune everything downstream of it, so the timing isolates the
     # pipeline prefix without output-transfer skew.  Each stage variant
-    # is a separate compilation; opt-in (RAFT_TPU_BENCH_BREAKDOWN=1,
-    # results written to BREAKDOWN.json) so the driver's headline run
-    # stays fast.
+    # is a separate compilation; opt-in (RAFT_TPU_BENCH_BREAKDOWN=1;
+    # the stage timings land in the printed JSON's breakdown block) so
+    # the driver's headline run stays fast.
     t_stat = t_dyn = None
     budget = float(os.environ.get("RAFT_TPU_BENCH_STAGE_BUDGET_S", "200"))
     if os.environ.get("RAFT_TPU_BENCH_BREAKDOWN", "0") != "0" \

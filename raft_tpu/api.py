@@ -304,18 +304,27 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
         settings = model.design.get("settings", {}) or {}
         scales = tuple(float(s) for s in settings.get(
             "bem_geom_scales", (0.92, 1.0, 1.08)))
-        if len(scales) != 3:
-            raise ValueError("bem_geom_scales: exactly 3 sample scales; "
-                             "d_scale should stay inside their span "
+        if len(scales) != 3 or len(set(scales)) != 3:
+            raise ValueError("bem_geom_scales: exactly 3 DISTINCT sample "
+                             "scales; d_scale should stay inside their span "
                              "(the quadratic fit extrapolates beyond it)")
-        bems = [bem if abs(s - 1.0) < 1e-12 else model.run_bem(d_scale=s)
-                for s in scales]
-        bem_samples = dict(
-            s=np.asarray(scales),
-            A=np.stack([np.asarray(b["A_BEM"]) for b in bems]),
-            B=np.stack([np.asarray(b["B_BEM"]) for b in bems]),
-            X=np.stack([np.asarray(b["X_BEM"]) for b in bems]),
-        )
+
+        _bem_cache = []
+
+        def bem_samples():
+            """Sampled-coefficient table, solved lazily on the first
+            geometry_constants trace that carries a d_scale (so DoEs
+            that never vary the diameter pay no extra solves)."""
+            if not _bem_cache:
+                bems = [bem if abs(s - 1.0) < 1e-12
+                        else model.run_bem(d_scale=s) for s in scales]
+                _bem_cache.append(dict(
+                    s=np.asarray(scales),
+                    A=np.stack([np.asarray(b["A_BEM"]) for b in bems]),
+                    B=np.stack([np.asarray(b["B_BEM"]) for b in bems]),
+                    X=np.stack([np.asarray(b["X_BEM"]) for b in bems]),
+                ))
+            return _bem_cache[0]
 
     # external difference-frequency QTF on the model grid
     qtf = model.qtf
@@ -357,17 +366,18 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
             A_hydro=A_hydro_t,
             hc0=dict(hc0_t, A_hydro=A_hydro_t),
         )
-        if bem_samples is not None:
-            gs = jnp.asarray(geom.get("d_scale", 1.0), dtype=float)
+        if bem_samples is not None and "d_scale" in geom:
+            gs = jnp.asarray(geom["d_scale"], dtype=float)
             if gs.ndim != 0:
                 raise ValueError(
                     "potential-flow geometry interpolation supports a "
                     "SCALAR d_scale (one uniform diameter scale); keep it "
                     "inside the bem_geom_scales span — the quadratic fit "
                     "extrapolates beyond it")
-            out["A_BEM6"] = _lagrange3(bem_samples["A"], bem_samples["s"], gs)
-            out["B_BEM6"] = _lagrange3(bem_samples["B"], bem_samples["s"], gs)
-            out["X_BEM6"] = _lagrange3(bem_samples["X"], bem_samples["s"], gs)
+            tab = bem_samples()
+            out["A_BEM6"] = _lagrange3(tab["A"], tab["s"], gs)
+            out["B_BEM6"] = _lagrange3(tab["B"], tab["s"], gs)
+            out["X_BEM6"] = _lagrange3(tab["X"], tab["s"], gs)
         return out
 
     def evaluate(case):
@@ -813,7 +823,6 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None,
     C_elast = np.asarray(stat["C_elast"])
     F_und = np.asarray(stat["W_struc"] + stat["W_hydro"] + stat["f0_additional"])
     M_struc = np.asarray(stat["M_struc"])
-    A_hydro = np.asarray(fh.hc0["A_hydro"])
     hc0 = fh.hc0
     Tn0 = jnp.asarray(fs.T).reshape(fs.n_nodes, 6, nDOF)
 

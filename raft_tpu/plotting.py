@@ -52,47 +52,31 @@ def plot_system(model, ax=None, color="k", n_theta=12):
     return ax
 
 
-def _plot_member(ax, mem, off, color="k", n_theta=12):
+def _member_polylines(mem, off, n_theta=12):
+    """Station rings + longitudinal lines of one member as 3-D
+    polylines (shared by the 3-D renderer and the projected 2-D view)."""
     th = np.linspace(0, 2 * np.pi, n_theta + 1)
-    pts_a, pts_b = [], []
+    rings = []
     for i in range(len(mem.stations)):
-        c = off + mem.rA0 + mem.q0 * mem.stations[i]
-        d = mem.d[i]
-        ring = c[None, :] + 0.5 * d[0] * np.cos(th)[:, None] * mem.p10[None, :] \
-            + 0.5 * d[1] * np.sin(th)[:, None] * mem.p20[None, :]
-        ax.plot(ring[:, 0], ring[:, 1], ring[:, 2], color=color, lw=0.5)
-        pts_a.append(ring)
-    for k in range(0, n_theta + 1, max(1, n_theta // 4)):
-        line = np.stack([r[k] for r in pts_a])
-        ax.plot(line[:, 0], line[:, 1], line[:, 2], color=color, lw=0.5)
+        c = off + np.asarray(mem.rA0) + np.asarray(mem.q0) * mem.stations[i]
+        d = np.atleast_1d(np.asarray(mem.d[i], dtype=float))
+        d = d if d.size == 2 else np.r_[d, d]
+        rings.append(c[None, :]
+                     + 0.5 * d[0] * np.cos(th)[:, None] * np.asarray(mem.p10)[None, :]
+                     + 0.5 * d[1] * np.sin(th)[:, None] * np.asarray(mem.p20)[None, :])
+    lines = [np.stack([r[k] for r in rings])
+             for k in range(0, n_theta + 1, max(1, n_theta // 4))]
+    return rings + lines
+
+
+def _plot_member(ax, mem, off, color="k", n_theta=12):
+    for pts in _member_polylines(mem, off, n_theta=n_theta):
+        ax.plot(pts[:, 0], pts[:, 1], pts[:, 2], color=color, lw=0.5)
 
 
 def _plot_line(ax, rA, rB, L, w_line, EA, n=30):
     """Catenary profile between two points (for rendering only)."""
-    import jax.numpy as jnp
-
-    from raft_tpu.physics.mooring import solve_catenary, _profile
-
-    lo, hi = (rA, rB) if rA[2] <= rB[2] else (rB, rA)
-    dv = np.asarray(hi) - np.asarray(lo)
-    XF = max(np.hypot(dv[0], dv[1]), 1e-6)
-    uh = dv[:2] / XF
-    HF, VF, _, _ = solve_catenary(jnp.asarray(XF), jnp.asarray(dv[2]),
-                                  jnp.asarray(float(L)), jnp.asarray(float(w_line)),
-                                  jnp.asarray(float(EA)))
-    s = np.linspace(0, float(L), n)
-    xs, zs = [], []
-    for si in s:
-        VFs = float(VF) - float(w_line) * (float(L) - si)
-        x, z = _profile(jnp.asarray(float(HF)), jnp.asarray(max(VFs, 0.0) if VFs < 0 else VFs),
-                        jnp.asarray(si), jnp.asarray(float(w_line)), jnp.asarray(float(EA)))
-        xs.append(float(x))
-        zs.append(float(z))
-    xs = np.clip(np.asarray(xs), 0, XF)
-    zs = np.asarray(zs)
-    pts = np.stack([np.asarray(lo)[0] + uh[0] * xs,
-                    np.asarray(lo)[1] + uh[1] * xs,
-                    np.asarray(lo)[2] + zs], axis=1)
+    pts = _catenary_points(rA, rB, L, w_line, EA, n=n)
     ax.plot(pts[:, 0], pts[:, 1], pts[:, 2], color="tab:blue", lw=0.8)
 
 
@@ -106,8 +90,117 @@ def plot_responses(model, channels=("surge", "heave", "pitch"), ifowt=0):
     for iCase, per_fowt in model.results["case_metrics"].items():
         m = per_fowt[ifowt]
         for ax, ch in zip(axs, channels):
-            ax.plot(f_hz, np.asarray(m[f"{ch}_PSD"]), label=f"case {iCase + 1}")
+            # rad/s-density PSDs on a Hz axis need the 2 pi conversion
+            # (reference plotResponses, raft_model.py:1363)
+            ax.plot(f_hz, 2 * np.pi * np.asarray(m[f"{ch}_PSD"]),
+                    label=f"case {iCase + 1}")
             ax.set_ylabel(f"{ch} PSD")
     axs[0].legend()
     axs[-1].set_xlabel("frequency [Hz]")
+    return fig, axs
+
+
+def plot2d(model, ax=None, color="k", Xuvec=(1, 0, 0), Yuvec=(0, 0, 1),
+           figsize=(6, 4), n_theta=12):
+    """2-D projection of the whole system — member outlines and mooring
+    catenary profiles projected onto the plane spanned by ``Xuvec`` /
+    ``Yuvec`` (``Model.plot2d`` equivalent, raft_model.py:1599-1630;
+    the default is the x-z side view)."""
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        fig, ax = plt.subplots(1, 1, figsize=figsize)
+    else:
+        fig = ax.get_figure()
+    Xu = np.asarray(Xuvec, dtype=float)
+    Yu = np.asarray(Yuvec, dtype=float)
+
+    proj = lambda pts: (pts @ Xu, pts @ Yu)
+
+    for i, fs in enumerate(model.fowtList):
+        off = np.array([fs.x_ref, fs.y_ref, 0.0])
+        for mem in fs.members:
+            if mem.part_of == "nacelle":
+                continue
+            for pts in _member_polylines(mem, off, n_theta=n_theta):
+                x, y = proj(pts)
+                ax.plot(x, y, color=color, lw=0.5)
+        ms = model.ms_list[i]
+        if ms is not None:
+            for il in range(ms.n_lines):
+                pts = _catenary_points(ms.r_anchor[il], off + ms.r_fair0[il],
+                                       ms.L[il], ms.w[il], ms.EA[il])
+                x, y = proj(pts)
+                ax.plot(x, y, color="tab:blue", lw=0.8)
+    # shared-mooring network lines (arrays), as in plot_system
+    if model.ms_array is not None:
+        import jax.numpy as jnp
+
+        net = model.ms_array
+        r6 = np.stack([[f.x_ref, f.y_ref, 0, 0, 0, 0] for f in model.fowtList])
+        _, info = net.body_forces(jnp.asarray(r6, dtype=float))
+        pos = np.asarray(net._point_positions(jnp.asarray(r6, dtype=float),
+                                              info["r_free"]))
+        for (a, b), L, w_l, EA in zip(net.l_ends, net.l_L, net.l_w, net.l_EA):
+            pts = _catenary_points(pos[a], pos[b], L, w_l, EA)
+            x, y = proj(pts)
+            ax.plot(x, y, color="tab:blue", lw=0.8)
+    ax.axis("equal")
+    ax.set_xlabel("[m]")
+    ax.set_ylabel("[m]")
+    return fig, ax
+
+
+def _catenary_points(rA, rB, L, w_line, EA, n=30):
+    """Catenary profile polyline between two points (shared by the 3-D
+    and 2-D renderers)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.physics.mooring import _profile, solve_catenary
+
+    lo, hi = (rA, rB) if rA[2] <= rB[2] else (rB, rA)
+    dv = np.asarray(hi) - np.asarray(lo)
+    XF = max(np.hypot(dv[0], dv[1]), 1e-6)
+    uh = dv[:2] / XF
+    HF, VF, _, _ = solve_catenary(
+        jnp.asarray(XF), jnp.asarray(dv[2]), jnp.asarray(float(L)),
+        jnp.asarray(float(w_line)), jnp.asarray(float(EA)))
+    s = np.linspace(0, float(L), n)
+    xs, zs = [], []
+    for si in s:
+        VFs = float(VF) - float(w_line) * (float(L) - si)
+        x, z = _profile(jnp.asarray(float(HF)),
+                        jnp.asarray(max(VFs, 0.0) if VFs < 0 else VFs),
+                        jnp.asarray(si), jnp.asarray(float(w_line)),
+                        jnp.asarray(float(EA)))
+        xs.append(float(x))
+        zs.append(float(z))
+    xs = np.clip(np.asarray(xs), 0, XF)
+    zs = np.asarray(zs)
+    return np.stack([np.asarray(lo)[0] + uh[0] * xs,
+                     np.asarray(lo)[1] + uh[1] * xs,
+                     np.asarray(lo)[2] + zs], axis=1)
+
+
+def plot_responses_extended(model, ifowt=0):
+    """9-panel PSD figure of the standard response channels per case
+    (``Model.plotResponses_extended`` equivalent,
+    raft_model.py:1463-1530)."""
+    import matplotlib.pyplot as plt
+
+    chans = ("surge", "sway", "heave", "pitch", "roll", "yaw", "AxRNA",
+             "Mbase", "wave")
+    fig, axs = plt.subplots(len(chans), 1, sharex=True,
+                            figsize=(8, 1.6 * len(chans)))
+    f_hz = model.w / (2 * np.pi)
+    two_pi = 2 * np.pi
+    for iCase, per_fowt in model.results["case_metrics"].items():
+        m = per_fowt[ifowt]
+        for ax, ch in zip(axs, chans):
+            psd = np.asarray(m[f"{ch}_PSD"])
+            psd = psd[:, 0] if psd.ndim == 2 else psd
+            ax.plot(f_hz, two_pi * psd, label=f"case {iCase + 1}")
+            ax.set_ylabel(f"{ch}\nPSD")
+    axs[-1].set_xlabel("frequency [Hz]")
+    axs[0].legend(fontsize=7)
     return fig, axs

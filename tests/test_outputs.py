@@ -95,3 +95,27 @@ def test_modes_json(volturn_case_metrics, tmp_path=None):
     for mode in doc["Modes"]:
         assert len(mode["Displ"]) == n_nodes
         assert mode["frequency"] > 0
+
+
+def test_plot2d_and_extended_responses(volturn_case_metrics):
+    """plot2d (projected geometry + mooring profiles) and the 9-panel
+    extended response-PSD figure render without error (Model.plot2d /
+    plotResponses_extended equivalents, raft_model.py:1599/:1463)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from raft_tpu.plotting import plot2d, plot_responses_extended
+
+    model, results = volturn_case_metrics
+    fig, ax = plot2d(model)                      # x-z side view
+    assert len(ax.lines) > 10
+    plt.close(fig)
+    fig, ax = plot2d(model, Xuvec=(1, 0, 0), Yuvec=(0, 1, 0))  # plan view
+    plt.close(fig)
+    fig, axs = plot_responses_extended(model)
+    assert len(axs) == 9
+    for a in axs:
+        assert len(a.lines) == 2                 # one per case
+    plt.close(fig)
