@@ -859,9 +859,19 @@ class Model:
             "case_metrics": {},
             "mean_offsets": [],
         }
+        from raft_tpu.utils.structlog import log_event, stage
+
         for iCase, case in enumerate(self.cases):
-            X0 = self.solve_statics(case)
-            Xi, info = self.solve_dynamics(case, X0=X0)
+            with stage("solve_statics", case=iCase):
+                X0 = self.solve_statics(case)
+            with stage("solve_dynamics", case=iCase):
+                Xi, info = self.solve_dynamics(case, X0=X0)
+            for i, inf in enumerate(info.get("infos", [])):
+                dd = inf.get("dyn_diag")
+                if dd is not None:
+                    log_event("drag_linearisation", case=iCase, fowt=i,
+                              resid=float(dd["drag_resid"]),
+                              converged=bool(dd["drag_converged"]))
             # feed mean drift back into the equilibrium for ANY 2nd-order
             # configuration — the reference re-runs solveStatics with
             # Fhydro_2nd_mean whenever potSecOrder > 0, slender-body QTFs

@@ -91,9 +91,11 @@ def plot_responses(model, channels=("surge", "heave", "pitch"), ifowt=0):
         m = per_fowt[ifowt]
         for ax, ch in zip(axs, channels):
             # rad/s-density PSDs on a Hz axis need the 2 pi conversion
-            # (reference plotResponses, raft_model.py:1363)
-            ax.plot(f_hz, 2 * np.pi * np.asarray(m[f"{ch}_PSD"]),
-                    label=f"case {iCase + 1}")
+            # (reference plotResponses, raft_model.py:1363); per-rotor
+            # channels plot rotor 0
+            psd = np.asarray(m[f"{ch}_PSD"])
+            psd = psd[:, 0] if psd.ndim == 2 else psd
+            ax.plot(f_hz, 2 * np.pi * psd, label=f"case {iCase + 1}")
             ax.set_ylabel(f"{ch} PSD")
     axs[0].legend()
     axs[-1].set_xlabel("frequency [Hz]")
@@ -169,8 +171,7 @@ def _catenary_points(rA, rB, L, w_line, EA, n=30):
     xs, zs = [], []
     for si in s:
         VFs = float(VF) - float(w_line) * (float(L) - si)
-        x, z = _profile(jnp.asarray(float(HF)),
-                        jnp.asarray(max(VFs, 0.0) if VFs < 0 else VFs),
+        x, z = _profile(jnp.asarray(float(HF)), jnp.asarray(max(VFs, 0.0)),
                         jnp.asarray(si), jnp.asarray(float(w_line)),
                         jnp.asarray(float(EA)))
         xs.append(float(x))
@@ -186,21 +187,8 @@ def plot_responses_extended(model, ifowt=0):
     """9-panel PSD figure of the standard response channels per case
     (``Model.plotResponses_extended`` equivalent,
     raft_model.py:1463-1530)."""
-    import matplotlib.pyplot as plt
-
-    chans = ("surge", "sway", "heave", "pitch", "roll", "yaw", "AxRNA",
-             "Mbase", "wave")
-    fig, axs = plt.subplots(len(chans), 1, sharex=True,
-                            figsize=(8, 1.6 * len(chans)))
-    f_hz = model.w / (2 * np.pi)
-    two_pi = 2 * np.pi
-    for iCase, per_fowt in model.results["case_metrics"].items():
-        m = per_fowt[ifowt]
-        for ax, ch in zip(axs, chans):
-            psd = np.asarray(m[f"{ch}_PSD"])
-            psd = psd[:, 0] if psd.ndim == 2 else psd
-            ax.plot(f_hz, two_pi * psd, label=f"case {iCase + 1}")
-            ax.set_ylabel(f"{ch}\nPSD")
-    axs[-1].set_xlabel("frequency [Hz]")
-    axs[0].legend(fontsize=7)
-    return fig, axs
+    return plot_responses(
+        model,
+        channels=("surge", "sway", "heave", "pitch", "roll", "yaw", "AxRNA",
+                  "Mbase", "wave"),
+        ifowt=ifowt)

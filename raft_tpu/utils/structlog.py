@@ -1,0 +1,80 @@
+"""Structured (JSONL) event logging for long-running analyses.
+
+SURVEY §5.1: the reference's only instrumentation is a wall-clock print
+around the QTF loop (raft_model.py:1122-1126).  Here every analysis
+stage can emit machine-readable events — stage name, wall time,
+convergence diagnostics — as one JSON object per line.
+
+Off by default (zero overhead beyond an env check).  Enable with
+
+    RAFT_TPU_LOG=-            # JSONL to stderr
+    RAFT_TPU_LOG=/path/f.jsonl  # JSONL appended to a file
+
+Events carry a monotonic ``t`` (seconds since process start) and a
+``event`` name; everything else is free-form numeric/str payload.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_T0 = time.perf_counter()
+_SINK = None
+_CHECKED = False
+
+
+def _sink():
+    global _SINK, _CHECKED
+    if not _CHECKED:
+        _CHECKED = True
+        dest = os.environ.get("RAFT_TPU_LOG", "")
+        if dest == "-":
+            _SINK = sys.stderr
+        elif dest:
+            _SINK = open(dest, "a")
+    return _SINK
+
+
+def enabled():
+    return _sink() is not None
+
+
+def log_event(event, **payload):
+    """Emit one JSONL event (no-op unless RAFT_TPU_LOG is set)."""
+    s = _sink()
+    if s is None:
+        return
+    rec = {"t": round(time.perf_counter() - _T0, 6), "event": event}
+    for k, v in payload.items():
+        if hasattr(v, "item"):
+            try:
+                v = v.item()
+            except Exception:
+                v = str(v)
+        rec[k] = v
+    s.write(json.dumps(rec) + "\n")
+    s.flush()
+
+
+class stage:
+    """Context manager timing one analysis stage:
+
+    with stage("solve_dynamics", case=2): ...
+    emits {"event": "solve_dynamics", "wall_s": ..., **kw} on exit."""
+
+    def __init__(self, name, **kw):
+        self.name = name
+        self.kw = kw
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if enabled():
+            log_event(self.name, wall_s=round(time.perf_counter() - self.t0, 6),
+                      ok=exc[0] is None, **self.kw)
+        return False

@@ -287,3 +287,31 @@ def test_mcf_cm_table_accuracy():
     got_np = mcf_cm(x)
     got_j = np.asarray(mcf_cm(jnp.asarray(x)))
     assert np.array_equal(got_j, got_np)
+
+
+def test_structlog_events(tmp_path, monkeypatch):
+    """Structured JSONL logging (SURVEY §5.1): stage timing and events
+    are emitted as one JSON object per line when RAFT_TPU_LOG is set,
+    and the module is a strict no-op otherwise."""
+    import importlib
+    import json
+
+    import raft_tpu.utils.structlog as sl
+
+    dest = tmp_path / "log.jsonl"
+    monkeypatch.setenv("RAFT_TPU_LOG", str(dest))
+    importlib.reload(sl)
+    with sl.stage("unit_stage", case=3):
+        pass
+    sl.log_event("custom", resid=1.5e-3, converged=True)
+    lines = [json.loads(x) for x in dest.read_text().splitlines()]
+    assert lines[0]["event"] == "unit_stage"
+    assert lines[0]["ok"] is True and lines[0]["case"] == 3
+    assert lines[0]["wall_s"] >= 0
+    assert lines[1] == {"t": lines[1]["t"], "event": "custom",
+                        "resid": 1.5e-3, "converged": True}
+
+    monkeypatch.delenv("RAFT_TPU_LOG")
+    importlib.reload(sl)
+    assert not sl.enabled()
+    sl.log_event("dropped")  # no sink, no error
