@@ -502,13 +502,87 @@ def _enable_compile_cache():
         pass
 
 
+BASELINE_ARTIFACT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BASELINE_MEASURED.json")
+
+
+def _load_or_measure_baseline(max_measure_s):
+    """Seconds per FULL design evaluation (12-case table) for the serial
+    NumPy twin.  The round-3/4 timeouts were budgeting failures: the
+    ~28.5 s/case baseline was re-measured *inside* every timed attempt
+    (12 cases = ~342 s of a 513 s deadline re-measuring a constant).
+    Now the measured value is persisted as a repo artifact
+    (BASELINE_MEASURED.json, value + host fingerprint) and reused; a
+    fresh measurement only happens if the artifact is missing, runs in
+    the parent *before* any attempt deadline, uses NBASE=1 by default,
+    and refreshes the artifact for next time."""
+    import socket
+
+    try:
+        with open(BASELINE_ARTIFACT) as f:
+            art = json.load(f)
+        # the artifact is only valid on the host that measured it —
+        # reusing a baseline from a different machine would make
+        # vs_baseline a cross-host ratio
+        if art.get("host") == socket.gethostname():
+            return (float(art["design_eval_s"]),
+                    art.get("host", "?") + " (artifact)")
+    except Exception:
+        pass
+
+    n_base = int(os.environ.get("RAFT_TPU_BENCH_NBASE", "1"))
+    model = _baseline_model()
+    cases = [dict(wind_speed=c[0], wind_heading=c[1], turbulence=c[2],
+                  wave_height=c[3], wave_period=c[4], wave_heading=c[5])
+             for c in CASES]
+    numpy_eval_case(model, cases[0])  # one-time statics JIT warmup
+    times = []
+    t_all0 = time.perf_counter()
+    for i in range(n_base):
+        t0 = time.perf_counter()
+        numpy_eval_case(model, cases[i % len(cases)])
+        times.append(time.perf_counter() - t0)
+        if time.perf_counter() - t_all0 > max_measure_s:
+            break
+    design_eval_s = float(np.mean(times)) * len(CASES)
+    host = socket.gethostname()
+    try:
+        with open(BASELINE_ARTIFACT, "w") as f:
+            json.dump(dict(design_eval_s=design_eval_s,
+                           case_s_mean=float(np.mean(times)),
+                           n_measured=len(times), host=host,
+                           workload="VolturnUS-S 100w x 12 cases, serial "
+                                    "NumPy twin (bench.numpy_eval_case)"), f)
+    except Exception:
+        pass
+    return design_eval_s, host
+
+
+def _baseline_model():
+    import raft_tpu
+    from raft_tpu.structure.schema import load_design
+
+    design = load_design(VOLTURN)
+    design["settings"]["min_freq"] = 0.002
+    design["settings"]["max_freq"] = 0.2
+    return raft_tpu.Model(design)
+
+
 def main():
-    """Driver entry: run the full geometry-DoE bench in a subprocess
-    with a deadline; if it cannot finish (e.g. an accelerator-compiler
-    blowup), fall back to the fixed-geometry configuration so the
-    driver ALWAYS receives a benchmark number (round-3 lesson: the
-    full config timed out silently and the round shipped without any
-    performance evidence)."""
+    """Driver entry.  Budget discipline (the round-4 lesson):
+
+    1. the NumPy baseline is resolved FIRST, outside any attempt
+       deadline, from the persisted artifact (free) or a single-case
+       measurement (~30 s);
+    2. the PROVEN configuration (flat: one baked geometry, (B*12,)
+       case batch — the round-2 config that produced 28.35 evals/s)
+       runs first under a bounded deadline, so a number is banked
+       early;
+    3. the geometry-DoE configuration gets the remainder; if it
+       succeeds its (strictly harder) number is reported, otherwise
+       the banked flat number is.
+    Each attempt runs in a subprocess so an accelerator-compiler
+    blowup cannot take down the whole bench."""
     import subprocess
     import sys
 
@@ -519,12 +593,19 @@ def main():
 
     budget = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", "1350"))
     t_start = time.perf_counter()
-    attempts = [("geom", 0.62), ("flat", 1.0)]
+    base_eval_s, base_host = _load_or_measure_baseline(
+        max_measure_s=min(120.0, 0.15 * budget))
+
+    attempts = [("flat", 0.45), ("geom", 1.0)]
+    results = {}
     last_err = ""
     for mode, share in attempts:
-        remaining = budget - (time.perf_counter() - t_start)
+        remaining = budget - (time.perf_counter() - t_start) - 10.0
         deadline = max(60.0, remaining * share)
-        env = dict(os.environ, RAFT_TPU_BENCH_MODE=mode)
+        env = dict(os.environ, RAFT_TPU_BENCH_MODE=mode,
+                   RAFT_TPU_BENCH_BASE_EVAL_S=repr(base_eval_s),
+                   RAFT_TPU_BENCH_BASE_HOST=base_host,
+                   RAFT_TPU_BENCH_DEADLINE_S=repr(deadline))
         try:
             p = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
@@ -539,10 +620,16 @@ def main():
                 continue
             if not (isinstance(parsed, dict) and "metric" in parsed):
                 continue  # stray JSON-ish stdout line, not the result
-            print(line)
+            results[mode] = line
+            break
+        else:
+            tail = (p.stderr or "").strip().splitlines()[-3:]
+            last_err = f"mode={mode} rc={p.returncode}: " + " | ".join(tail)
+    # geometry-DoE is the headline when it finished; flat is the bank
+    for mode in ("geom", "flat"):
+        if mode in results:
+            print(results[mode])
             return
-        tail = (p.stderr or "").strip().splitlines()[-3:]
-        last_err = f"mode={mode} rc={p.returncode}: " + " | ".join(tail)
     print(json.dumps({
         "metric": "design-evals/sec/chip (VolturnUS-S, 100w x 12 cases)",
         "value": 0.0, "unit": "design-evals/s", "vs_baseline": 0.0,
@@ -550,13 +637,79 @@ def main():
     }))
 
 
+def _deadline_remaining(t_start):
+    """Seconds left before the parent kills this attempt (None if run
+    standalone)."""
+    d = os.environ.get("RAFT_TPU_BENCH_DEADLINE_S")
+    if not d:
+        return None
+    return float(d) - (time.perf_counter() - t_start)
+
+
+def _stage_times(jit_builder, args, reps, t_compile, dt, t_start):
+    """Stage attribution by dead-code elimination: jitting a function
+    that returns only (a scalar reduction of) an intermediate lets XLA
+    prune everything downstream of it, so the timing isolates the
+    pipeline prefix without output-transfer skew.  On by default
+    (RAFT_TPU_BENCH_BREAKDOWN=0 to skip), but each stage variant is a
+    separate compilation, so it only runs when the attempt deadline
+    leaves room for ~2 more compiles after the headline number is in
+    hand.  ``jit_builder(key)`` -> compiled/jitted pruned pipeline.
+
+    Returns (t_stat, t_dyn): raw per-executable times of the
+    statics+equilibrium prefix and the through-drag-solve prefix, or
+    (None, None) when skipped/failed."""
+    import jax
+
+    remaining = _deadline_remaining(t_start)
+    room = remaining is None or remaining > 2.4 * max(t_compile, 5.0) + 8 * dt
+    if os.environ.get("RAFT_TPU_BENCH_BREAKDOWN", "1") == "0" or not room:
+        return None, None
+    try:
+        def timed(f):
+            jax.block_until_ready(f(*args))
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                jax.block_until_ready(f(*args))
+            return (time.perf_counter() - t0) / reps
+
+        t_stat = timed(jit_builder("X0"))  # geometry+statics+aero+equilib.
+        t_dyn = timed(jit_builder("Z"))    # + excitation + drag-lin solve
+        return t_stat, t_dyn
+    except Exception:
+        return None, None
+
+
+def _finish_breakdown(breakdown, t_compile, dt, t_stat, t_dyn,
+                      base_per_sec, batch_designs, distinct_geometries):
+    """Shared breakdown block.  Stage prefixes are reported as RAW
+    times of their own executables (differences between separately
+    compiled programs can be negative and misattribute time); derived
+    splits are clamped at zero."""
+    breakdown.update(
+        compile_s=round(t_compile, 2),
+        full_pipeline_s=round(dt, 4),
+        prefix_statics_equilibrium_s=round(t_stat, 4) if t_stat else None,
+        prefix_through_drag_solve_s=round(t_dyn, 4) if t_dyn else None,
+        drag_solve_minus_statics_s=(round(max(t_dyn - t_stat, 0.0), 4)
+                                    if t_dyn and t_stat else None),
+        psd_tail_s=round(max(dt - t_dyn, 0.0), 4) if t_dyn else None,
+        baseline_design_eval_s=round(1.0 / base_per_sec, 2),
+        baseline_host=os.environ.get("RAFT_TPU_BENCH_BASE_HOST"),
+        batch_designs=batch_designs,
+        distinct_geometries=distinct_geometries,
+    )
+    return breakdown
+
+
 def run_mode(mode):
+    t_start = time.perf_counter()
     _enable_compile_cache()
     import jax
     import jax.numpy as jnp
 
     if mode == "flat":
-        run_flat()
+        run_flat(t_start)
         return
 
     model, evaluate = build()
@@ -606,23 +759,10 @@ def run_mode(mode):
     dt = timed(compiled, *args)
     design_evals_per_sec = B / dt
 
-    # stage attribution by dead-code elimination: jitting a function
-    # that returns only (a scalar reduction of) an intermediate lets XLA
-    # prune everything downstream of it, so the timing isolates the
-    # pipeline prefix without output-transfer skew.  Each stage variant
-    # is a separate compilation; opt-in (RAFT_TPU_BENCH_BREAKDOWN=1;
-    # the stage timings land in the printed JSON's breakdown block) so
-    # the driver's headline run stays fast.
-    t_stat = t_dyn = None
-    budget = float(os.environ.get("RAFT_TPU_BENCH_STAGE_BUDGET_S", "200"))
-    if os.environ.get("RAFT_TPU_BENCH_BREAKDOWN", "0") != "0" \
-            and t_compile < budget:
-        fn_x0 = jax.jit(jax.vmap(
-            lambda *a: jnp.sum(jnp.abs(eval_case(*a, key="X0")))))
-        fn_z = jax.jit(jax.vmap(
-            lambda *a: jnp.sum(jnp.abs(eval_case(*a, key="Z")))))
-        t_stat = timed(fn_x0, *args)  # geometry + statics + aero + equilibrium
-        t_dyn = timed(fn_z, *args)    # + excitation + drag-linearised solve
+    t_stat, t_dyn = _stage_times(
+        lambda key: jax.jit(jax.vmap(
+            lambda *a: jnp.sum(jnp.abs(eval_case(*a, key=key))))),
+        args, reps, t_compile, dt, t_start)
 
     # optional profiler capture (point RAFT_TPU_PROFILE at a directory
     # and open the trace in TensorBoard / Perfetto)
@@ -632,14 +772,9 @@ def run_mode(mode):
             jax.block_until_ready(compiled(*args))
 
     base_design_evals_per_sec = _numpy_baseline(model)
-    breakdown = _flops_breakdown(compiled, dt)
-    breakdown.update(
-        compile_s=round(t_compile, 2),
-        statics_equilibrium_s=round(t_stat, 4) if t_stat else None,
-        drag_linearised_solve_s=round(t_dyn - t_stat, 4) if t_dyn else None,
-        response_psd_s=round(dt - t_dyn, 4) if t_dyn else None,
-        batch_designs=B, distinct_geometries=True,
-    )
+    breakdown = _finish_breakdown(
+        _flops_breakdown(compiled, dt), t_compile, dt, t_stat, t_dyn,
+        base_design_evals_per_sec, B, True)
     print(json.dumps({
         "metric": "design-evals/sec/chip (VolturnUS-S geometry DoE, 100w x 12 cases, operating turbine)",
         "value": round(design_evals_per_sec, 3),
@@ -673,12 +808,19 @@ def _flops_breakdown(compiled, dt):
 
 def _numpy_baseline(model):
     """Serial NumPy twin: design evaluations (12-case tables) per
-    second, reference-style loops."""
+    second.  Inside a bench attempt the parent has already resolved the
+    value (artifact or one bounded measurement) and passes it via env —
+    measuring here would burn the attempt's deadline on a constant
+    (the round-3/4 failure mode)."""
+    env_s = os.environ.get("RAFT_TPU_BENCH_BASE_EVAL_S")
+    if env_s:
+        return 1.0 / float(env_s)
     n_cases = len(CASES)
-    n_base = int(os.environ.get("RAFT_TPU_BENCH_NBASE", str(n_cases)))
+    n_base = int(os.environ.get("RAFT_TPU_BENCH_NBASE", "1"))
     cases = [dict(wind_speed=c[0], wind_heading=c[1], turbulence=c[2],
                   wave_height=c[3], wave_period=c[4], wave_heading=c[5])
              for c in CASES]
+    numpy_eval_case(model, cases[0])  # one-time statics JIT warmup
     t0 = time.perf_counter()
     for i in range(n_base):
         numpy_eval_case(model, cases[i % n_cases])
@@ -686,8 +828,8 @@ def _numpy_baseline(model):
     return 1.0 / (n_cases * base_case_dt)
 
 
-def run_flat():
-    """Fallback configuration (round-2 proven): ONE baked geometry,
+def run_flat(t_start=None):
+    """Banked configuration (round-2 proven): ONE baked geometry,
     flat (B*12,) case batch through the geometry=False evaluator."""
     import jax
     import jax.numpy as jnp
@@ -696,15 +838,17 @@ def run_flat():
     from raft_tpu.api import make_full_evaluator
     from raft_tpu.structure.schema import load_design
 
+    if t_start is None:
+        t_start = time.perf_counter()
     design = load_design(VOLTURN)
     design["settings"]["min_freq"] = 0.002
     design["settings"]["max_freq"] = 0.2
     model = raft_tpu.Model(design)
     evaluate = make_full_evaluator(model)
 
-    def eval_case(ws, wh, ti, hs, tp, bd):
+    def eval_case(ws, wh, ti, hs, tp, bd, key="PSD"):
         return evaluate(dict(wind_speed=ws, wind_heading_deg=wh, TI=ti,
-                             Hs=hs, Tp=tp, beta_deg=bd))["PSD"]
+                             Hs=hs, Tp=tp, beta_deg=bd))[key]
 
     n_cases = len(CASES)
     arr = np.array(CASES)
@@ -724,10 +868,15 @@ def run_flat():
     dt = (time.perf_counter() - t0) / reps
     design_evals_per_sec = B / dt
 
+    t_stat, t_dyn = _stage_times(
+        lambda key: jax.jit(jax.vmap(
+            lambda *a: jnp.sum(jnp.abs(eval_case(*a, key=key))))),
+        args, reps, t_compile, dt, t_start)
+
     base = _numpy_baseline(model)
-    breakdown = _flops_breakdown(compiled, dt)
-    breakdown.update(compile_s=round(t_compile, 2), batch_designs=B,
-                     distinct_geometries=False)
+    breakdown = _finish_breakdown(
+        _flops_breakdown(compiled, dt), t_compile, dt, t_stat, t_dyn,
+        base, B, False)
     print(json.dumps({
         "metric": "design-evals/sec/chip (VolturnUS-S, 100w x 12 cases, operating turbine)",
         "value": round(design_evals_per_sec, 3),

@@ -8,13 +8,17 @@
 // — here the influence-matrix assembly and dense solve live in C++
 // behind a C ABI consumed through ctypes.
 //
-// Current scope: frequency-limit radiation problems.
-//   mirror = -1 : high-frequency free-surface condition (phi = 0 on
-//                 z = 0, negative image)  -> A(w -> inf)
-//   mirror = +1 : rigid-lid condition (dphi/dz = 0, positive image)
-//                 -> A(w -> 0)
-// The finite-frequency wave Green function slots into the same
-// assembly (influence() below) as a follow-up.
+// Scope:
+//   * frequency-limit radiation problems:
+//       mirror = -1 : high-frequency free-surface condition (phi = 0
+//                     on z = 0, negative image)  -> A(w -> inf)
+//       mirror = +1 : rigid-lid condition (dphi/dz = 0, positive
+//                     image) -> A(w -> 0)
+//   * finite-frequency radiation/diffraction with the wave Green
+//     function: infinite depth via the tabulated Telste-Noblesse-style
+//     kernel (wave_term() below), finite depth via John's
+//     eigenfunction series with adaptive evanescent cutoff
+//     (fd_wave_term(), dispatched for Kh <= 6).
 //
 // Numerics: panel integrals by centroid collocation with 2x2 Gauss
 // refinement for near-field pairs and an analytic equivalent-disk self

@@ -24,6 +24,23 @@ def run(input_file, save_csv=None):
     return model
 
 
+def run_farm(input_file, save_csv=None):
+    """One-call farm driver (runRAFTFarm equivalent,
+    raft_model.py:2287-2310).  The reference's farm entry skips
+    ``analyzeUnloaded`` and ``calcOutputs`` (unsupported for arrays
+    there); here ``analyze_cases`` already covers the array path, so
+    this is the same one-call convenience with the farm-safe scope:
+    case metrics only, no single-FOWT property/eigen outputs.
+    Returns the Model."""
+    import raft_tpu
+
+    model = raft_tpu.Model(input_file)
+    model.analyze_cases()
+    if save_csv:
+        save_responses(model, save_csv)
+    return model
+
+
 def save_responses(model, path):
     """Write per-case channel statistics to CSV (saveResponses analog)."""
     rows = ["case,fowt,channel,avg,std,max,min"]

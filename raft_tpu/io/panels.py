@@ -373,6 +373,19 @@ def mesh_fowt(fs, dz_max=None, n_az=18, da_max=None, intersect=True):
     return verts, cents, norms, areas
 
 
+def _panel_geometry(verts):
+    """(centroids, normals, areas) for quad panels (P,4,3) by the
+    diagonal cross product; triangles are degenerate quads.  Normals
+    follow the winding; callers flip if needed."""
+    cents = verts.mean(axis=1)
+    d1 = verts[:, 2] - verts[:, 0]
+    d2 = verts[:, 3] - verts[:, 1]
+    nvec = np.cross(d1, d2)
+    areas = 0.5 * np.linalg.norm(nvec, axis=1)
+    norms = nvec / np.maximum(2 * areas, 1e-12)[:, None]
+    return cents, norms, areas
+
+
 def read_pnl(path):
     """Read a HAMS .pnl mesh (node-list + panel-connectivity layout, as
     written by pyhams / the reference pipeline).
@@ -414,13 +427,46 @@ def read_pnl(path):
                 idx = idx + [idx[2]]
             panels.append(idx)
     verts = np.array([[nodes[i] for i in p] for p in panels])
-    cents = verts.mean(axis=1)
-    d1 = verts[:, 2] - verts[:, 0]
-    d2 = verts[:, 3] - verts[:, 1]
-    nvec = np.cross(d1, d2)
-    areas = 0.5 * np.linalg.norm(nvec, axis=1)
-    norms = nvec / np.maximum(2 * areas, 1e-12)[:, None]
-    return verts, cents, norms, areas
+    return (verts,) + _panel_geometry(verts)
+
+
+def write_gdf(path, vertices, ulen=1.0, grav=9.8, isx=0, isy=0,
+              clip_above_water=False, title="raft_tpu gdf mesh"):
+    """Write panels in the WAMIT .gdf format
+    (member2pnl.py:writeMeshToGDF:847-875 and the GDF variants at
+    :314/:672): header, 'ULEN GRAV', 'ISX ISY' symmetry flags, panel
+    count, then 4 vertex rows per panel.
+
+    ``clip_above_water`` mirrors the reference's aboveWater=False
+    branch: panels entirely above z = 0 are dropped and vertices above
+    the waterline are moved down to z = 0."""
+    vertices = np.asarray(vertices, dtype=float).reshape(-1, 4, 3)
+    if clip_above_water:
+        keep = np.any(vertices[:, :, 2] < -0.001, axis=1)
+        vertices = vertices[keep].copy()
+        vertices[:, :, 2] = np.minimum(vertices[:, :, 2], 0.0)
+    with open(path, "w") as f:
+        f.write(f"{title}\n")
+        f.write(f"{ulen:.1f}   {grav:.1f}\n")
+        f.write(f"{isx}, {isy}\n")
+        f.write(f"{len(vertices)}\n")
+        for quad in vertices:
+            for v in quad:
+                f.write(f"{v[0]:>10.3f} {v[1]:>10.3f} {v[2]:>10.3f}\n")
+
+
+def read_gdf(path):
+    """Read a WAMIT .gdf mesh -> (vertices (P,4,3), centroids, normals,
+    areas) with the same conventions as :func:`read_pnl`."""
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    npan = int(lines[3].split()[0])
+    coords = []
+    for ln in lines[4:]:
+        coords.extend(float(t) for t in ln.split())
+    verts = np.asarray(coords, dtype=float).reshape(-1, 3)[:4 * npan]
+    verts = verts.reshape(npan, 4, 3)
+    return (verts,) + _panel_geometry(verts)
 
 
 def write_pnl(path, vertices, title="raft_tpu panel mesh"):

@@ -91,6 +91,66 @@ def write_wamit3(path, w, headings_deg, X, rho=1025.0, g=9.81):
                             f" {x.real: .6e} {x.imag: .6e}\n")
 
 
+def write_rao_4(path, w, Xi, beta_deg=0.0):
+    """Write motion RAOs in the WAMIT .4 column layout the reference
+    emits next to its QTF outputs (raft_fowt.py:2027-2041): rows of
+    [period, heading, DoF, |x|, phase(rad), Re x, Im x] for
+    ``Xi`` (ndof, nw) complex (response per unit wave amplitude)."""
+    w = np.asarray(w)
+    Xi = np.asarray(Xi)
+    with open(path, "w") as f:
+        for idof in range(Xi.shape[0]):
+            for wi, x in zip(w, Xi[idof]):
+                f.write(f"{2 * np.pi / wi: 8.6e} {beta_deg: 8.4e} "
+                        f"{idof + 1} {np.abs(x): 8.6e} "
+                        f"{np.angle(x): 8.6e} {x.real: 8.6e} "
+                        f"{x.imag: 8.6e}\n")
+
+
+def read_rao_4(path):
+    """Read a WAMIT .4 motion-RAO file (as written by write_rao_4 /
+    the reference's QTF debug output) -> (w (nw,), headings_deg (nh,),
+    Xi (nh, ndof, nw) complex), frequencies ascending."""
+    data = np.loadtxt(path)
+    w_all = 2 * np.pi / data[:, 0]
+    freqs = np.unique(w_all)
+    heads = np.unique(data[:, 1])
+    ndof = int(np.max(data[:, 2]))
+    Xi = np.zeros((len(heads), ndof, len(freqs)), dtype=complex)
+    fi = {f: n for n, f in enumerate(freqs)}
+    hi = {h: n for n, h in enumerate(heads)}
+    for row, wi in zip(data, w_all):
+        Xi[hi[row[1]], int(row[2]) - 1, fi[wi]] = row[5] + 1j * row[6]
+    return freqs, heads, Xi
+
+
+def read_wamit_p2(path, rho=1.0, ulen=1.0, g=1.0):
+    """Read a WAMIT .p2 second-order (sum/difference) output file into
+    per-DOF complex matrices — the readWAMIT_p2 equivalent
+    (/root/reference/raft/helpers.py:1434-1469).
+
+    Rows: [period, heading, DoF, |F|, phase, Re, Im].  Returns a dict
+    keyed 'surge'...'yaw' of (n_period, n_heading) complex arrays
+    dimensionalised by rho g ULEN^k (k = 2 for forces, 3 for moments),
+    plus 'period' and 'heading' vectors.  Defaults keep the data
+    nondimensional, as the reference does."""
+    data = np.loadtxt(path)
+    heads = np.unique(data[:, 1])
+    periods = np.unique(data[:, 0])
+    names = ["surge", "sway", "heave", "roll", "pitch", "yaw"]
+    k_ulen = [2, 2, 2, 3, 3, 3]
+    out = {}
+    for idof, name in enumerate(names):
+        rows = data[data[:, 2] == idof + 1]
+        rows = rows[np.lexsort((rows[:, 1], rows[:, 0]))]
+        re = rows[:, 5].reshape(-1, len(heads))
+        im = rows[:, 6].reshape(-1, len(heads))
+        out[name] = (re + 1j * im) * rho * g * ulen ** k_ulen[idof]
+    out["period"] = periods
+    out["heading"] = heads
+    return out
+
+
 def _interp_freq(w_model, w_data, Y, pad_zero_freq=None):
     """Linear interpolation along the last axis onto the model grid,
     with an optional value prepended at w = 0 (the reference pads the

@@ -43,6 +43,10 @@ class Model:
 
         # second-order QTF frequency grid (raft_fowt.py:410-425)
         platform0 = design.get("platform") or (design.get("platforms") or [{}])[0]
+        # QTF/RAO checkpoint folder (raft_fowt.py:434-436): when set,
+        # computed slender-body QTFs are persisted as WAMIT .12d and the
+        # converged motion RAOs as .4 next to them
+        self.out_folder_qtf = platform0.get("outFolderQTF")
         if "min_freq2nd" in platform0 and "max_freq2nd" in platform0:
             mf2 = platform0["min_freq2nd"]
             Mf2 = platform0["max_freq2nd"]
@@ -500,6 +504,31 @@ class Model:
                 qtf = self.qtf_slender(0, Xi0=RAO, ifowt=i)
                 qtf_data = dict(w_2nd=self.w1_2nd,
                                 heads_rad=np.asarray([fh.beta[0]]), qtf=qtf)
+                if self.out_folder_qtf:
+                    # persist in the reference's checkpoint formats
+                    # (raft_fowt.py:2027-2041 .4, :2072-2078 .12d); the
+                    # case index keeps multi-case runs from overwriting
+                    # each other (case-specific drag linearisation makes
+                    # the RAOs, hence the QTF, case-dependent)
+                    import os
+
+                    from raft_tpu.io.wamit import write_rao_4
+                    from raft_tpu.physics.secondorder import write_qtf_12d
+
+                    os.makedirs(self.out_folder_qtf, exist_ok=True)
+                    whead = float(np.degrees(fh.beta[0]))
+                    iCase = getattr(self, "_current_case_index", None)
+                    tag = (f"Head{whead:.0f}_WT{i}" if iCase is None
+                           else f"Head{whead:.0f}_Case{iCase + 1}_WT{i}")
+                    write_rao_4(os.path.join(
+                        self.out_folder_qtf, f"raos-slender_body_{tag}.4"),
+                        self.w, RAO, beta_deg=whead)
+                    write_qtf_12d(os.path.join(
+                        self.out_folder_qtf,
+                        f"qtf-slender_body-total_{tag}.12d"),
+                        np.asarray(qtf), self.w1_2nd,
+                        np.asarray([fh.beta[0]]),
+                        rho=fs.rho_water, g=fs.g)
                 for ih in range(nWaves):
                     fm, f2 = hydro_force_2nd(qtf_data, fh.beta[ih], fh.S[ih], self.w)
                     F_2nd = F_2nd.at[ih, :6, :].add(jnp.asarray(f2[:6]))
@@ -862,6 +891,7 @@ class Model:
         from raft_tpu.utils.structlog import log_event, stage
 
         for iCase, case in enumerate(self.cases):
+            self._current_case_index = iCase   # QTF checkpoint filenames
             with stage("solve_statics", case=iCase):
                 X0 = self.solve_statics(case)
             with stage("solve_dynamics", case=iCase):
@@ -896,4 +926,5 @@ class Model:
                     rotor_info=tc_i.get("rotor_info"),
                 )
                 self.results["case_metrics"][iCase][i] = metrics
+        self._current_case_index = None
         return self.results

@@ -69,6 +69,33 @@ def _load_freq(lib):
     lib._freq_ready = True
 
 
+def _fd_mode_count(depth, areas, n_modes, n_cap=16384):
+    """Evanescent mode count that keeps the C++ kernel's small-R
+    extrapolation cutoff Rc = 40 h / (pi n_modes) at or below HALF the
+    panel edge scale (sqrt of the smallest panel area), so near-field
+    accuracy tracks mesh refinement: on fine meshes or shallow sites the
+    default 512 modes put Rc above the panel spacing and every
+    near-diagonal wave influence (incl. the self term) came from the
+    quadratic-in-R^2 extrapolation.  The kernel's per-pair adaptive
+    cutoff (K0 decay) means the extra modes only cost work on the
+    near-diagonal pairs that need them."""
+    import warnings
+
+    d_panel = float(np.sqrt(np.min(np.asarray(areas))))
+    need = int(np.ceil(80.0 * depth / (np.pi * max(d_panel, 1e-9))))
+    if need <= n_modes:
+        return n_modes
+    if need > n_cap:
+        warnings.warn(
+            f"finite-depth Green function: {need} evanescent modes needed "
+            f"to resolve panel spacing {d_panel:.3g} m at depth {depth:.3g} "
+            f"m exceeds the cap {n_cap}; near-diagonal influences use the "
+            "smooth-remainder extrapolation below "
+            f"Rc={40.0 * depth / (np.pi * n_cap):.3g} m")
+        return n_cap
+    return need
+
+
 def solve_bem_frequency(vertices, centroids, normals, areas, omega,
                         headings_rad=(0.0,), depth=np.inf, rho=1025.0,
                         g=9.81, ref=(0.0, 0.0, 0.0), n_modes=512):
@@ -102,6 +129,7 @@ def solve_bem_frequency(vertices, centroids, normals, areas, omega,
         from raft_tpu.native.green_fd import _evan_coeffs, dispersion_roots
 
         K = omega * omega / g
+        n_modes = _fd_mode_count(float(depth), areas, int(n_modes))
         k0, km = dispersion_roots(K, float(depth), int(n_modes))
         Cm = _evan_coeffs(km, K, float(depth))
         rc = lib.panel_solve_frequency_fd(

@@ -132,33 +132,51 @@ def green_fd_reference(Rh, z, zeta, K, h):
     docstring) plus the two Rankine terms 1/r(=0 here; Rh>0 assumed
     with z != zeta possible) — returns the TOTAL G for validation.
 
+    The ratios N/D and N/D' are evaluated in exp-normalised form (every
+    exponent <= 0 for z, zeta in [-h, 0]: a+b-2c = mu(z+zeta) <= 0), so
+    the integrand never overflows even for near-surface point pairs at
+    large mu*h where the naive cosh/sinh factors exceed float range.
+
     Slow; used only in tests."""
     from scipy.integrate import quad
     from scipy.special import j0
 
     k0v, _ = dispersion_roots(K, h, 1)
 
-    def N(mu):
-        return ((mu + K) * np.exp(-mu * h)
-                * np.cosh(mu * (z + h)) * np.cosh(mu * (zeta + h)))
+    def N_over_D(mu):
+        # N = (mu+K) e^{-c} cosh a cosh b,  D = mu sinh c - K cosh c
+        # with a = mu(z+h), b = mu(zeta+h), c = mu h (all >= 0):
+        # N/D = (mu+K) e^{a+b-2c} (1+e^{-2a})(1+e^{-2b})
+        #       / (2 [mu(1-e^{-2c}) - K(1+e^{-2c})])
+        a = mu * (z + h)
+        b = mu * (zeta + h)
+        c = mu * h
+        num = (mu + K) * np.exp(a + b - 2 * c) \
+            * (1 + np.exp(-2 * a)) * (1 + np.exp(-2 * b))
+        den = 2.0 * (mu * (1 - np.exp(-2 * c)) - K * (1 + np.exp(-2 * c)))
+        return num / den
 
-    def D(mu):
-        return mu * np.sinh(mu * h) - K * np.cosh(mu * h)
+    def N_over_dD(mu):
+        # D' = sinh c + mu h cosh c - K h sinh c
+        #    = e^c/2 [(1-Kh)(1-e^{-2c}) + mu h (1+e^{-2c})]
+        a = mu * (z + h)
+        b = mu * (zeta + h)
+        c = mu * h
+        num = (mu + K) * np.exp(a + b - 2 * c) \
+            * (1 + np.exp(-2 * a)) * (1 + np.exp(-2 * b))
+        den = 2.0 * ((1 - K * h) * (1 - np.exp(-2 * c))
+                     + mu * h * (1 + np.exp(-2 * c)))
+        return num / den
 
     def integrand(mu):
-        return 2.0 * N(mu) / D(mu) * j0(mu * Rh)
+        return 2.0 * N_over_D(mu) * j0(mu * Rh)
 
     # PV: split at the pole k0 with symmetric excision + Cauchy weight
-    eps = 1e-6 * max(k0v, 1.0)
-
     def f_cauchy(mu):
         # integrand = fc(mu)/(mu - k0): fc = 2 N J0 (mu-k0)/D
-        Dv = D(mu)
         if abs(mu - k0v) < 1e-12:
-            # derivative limit
-            dD = (D(mu + 1e-6) - D(mu - 1e-6)) / 2e-6
-            return 2.0 * N(mu) * j0(mu * Rh) / dD
-        return 2.0 * N(mu) * j0(mu * Rh) * (mu - k0v) / Dv
+            return 2.0 * N_over_dD(mu) * j0(mu * Rh)  # derivative limit
+        return 2.0 * N_over_D(mu) * j0(mu * Rh) * (mu - k0v)
 
     a, b = max(k0v - 0.5 * k0v, 1e-10), k0v + 0.5 * k0v
     pv, _ = quad(f_cauchy, a, b, weight="cauchy", wvar=k0v, limit=400)
@@ -167,8 +185,7 @@ def green_fd_reference(Rh, z, zeta, K, h):
     span = max(60.0 / max(-(z + zeta), 1e-3), 30.0 / max(Rh, 1e-3), 50 / h)
     tail, _ = quad(integrand, b, b + span, limit=2000)
 
-    dD = (D(k0v + 1e-6) - D(k0v - 1e-6)) / 2e-6
-    res_term = 2j * np.pi * N(k0v) / dD * j0(k0v * Rh)
+    res_term = 2j * np.pi * N_over_dD(k0v) * j0(k0v * Rh)
 
     Gw = head + pv + tail + res_term
     r = np.sqrt(Rh ** 2 + (z - zeta) ** 2)

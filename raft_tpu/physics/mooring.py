@@ -537,14 +537,33 @@ def parse_moordyn_system(path, depth, rho=1025.0, g=9.81, moorMod=0):
                     d = float(toks[1])
                 except ValueError:
                     continue
+                # MoorDyn v2 line-type row has 10 columns
+                #   Name Diam Mass/m EA BA/-zeta EI Cd Ca CdAx CaAx
+                # MoorDyn v1 has 9, with the hydro coefficients in a
+                # DIFFERENT order (added mass first, normal/tangential):
+                #   Name Diam MassDen EA BA/-zeta Can Cat Cdn Cdt
+                # Distinguish by token count; mapping v1 rows through the
+                # v2 positions silently swaps Cd<->Ca (the moorMod 1/2
+                # dynamic-tension/impedance paths read them).
+                if len(toks) >= 10:      # v2: EI at 5, drag-first at 6+
+                    hydro = dict(
+                        Cd=float(toks[6]), Ca=float(toks[7]),
+                        CdAx=float(toks[8]), CaAx=float(toks[9]))
+                elif len(toks) == 9:     # v1: Can Cat Cdn Cdt at 5..8
+                    hydro = dict(
+                        Ca=float(toks[5]), CaAx=float(toks[6]),
+                        Cd=float(toks[7]), CdAx=float(toks[8]))
+                elif len(toks) <= 5:     # quasi-static-only row
+                    hydro = dict(Cd=1.2, Ca=1.0, CdAx=0.05, CaAx=0.0)
+                else:
+                    raise ValueError(
+                        f"ambiguous line-type row ({len(toks)} columns) in "
+                        f"{path}: expected 9 (MoorDyn v1) or >=10 (v2) "
+                        f"columns; got {line!r}")
                 types[toks[0]] = dict(
                     d=d, m=float(toks[2]), EA=float(toks[3]),
                     BA=float(toks[4]) if len(toks) > 4 else 0.0,
-                    Cd=float(toks[6]) if len(toks) > 6 else 1.2,
-                    Ca=float(toks[7]) if len(toks) > 7 else 1.0,
-                    CdAx=float(toks[8]) if len(toks) > 8 else 0.05,
-                    CaAx=float(toks[9]) if len(toks) > 9 else 0.0,
-                )
+                    **hydro)
             elif section == "points" and len(toks) >= 5:
                 try:
                     pid = int(toks[0])

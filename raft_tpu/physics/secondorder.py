@@ -53,6 +53,35 @@ def read_qtf_12d(path, rho=1025.0, g=9.81, ulen=1.0, ndof=6):
     return dict(w_2nd=w1, heads_rad=heads, qtf=qtf)
 
 
+def write_qtf_12d(path, qtf, w_2nd, heads_rad, rho=1025.0, g=9.81,
+                  ulen=1.0):
+    """Write a difference-frequency QTF in the WAMIT .12d interchange
+    format — the inverse of :func:`read_qtf_12d` and the checkpoint
+    format the reference uses to persist expensive 2nd-order results
+    (writeQTF, raft_fowt.py:2131-2156).
+
+    ``qtf`` (nw, nw, nh, ndof) complex, dimensional; only the upper
+    triangle i2 >= i1 is written (the matrix is hermitian).  Columns:
+    T1, T2, head, head, DoF, |F|, phase, Re F, Im F with
+    F = Q/(rho g ULEN) (extra ULEN for moments)."""
+    qtf = np.asarray(qtf)
+    w = np.asarray(w_2nd)
+    with open(path, "w") as f:
+        for ih in range(len(heads_rad)):
+            hd = np.rad2deg(heads_rad[ih])
+            for idof in range(qtf.shape[3]):
+                factor = rho * g * ulen * (ulen if idof >= 3 else 1.0)
+                for i1 in range(len(w)):
+                    for i2 in range(i1, len(w)):
+                        F = qtf[i1, i2, ih, idof] / factor
+                        f.write(
+                            f"{2 * np.pi / w[i1]: 8.6e} "
+                            f"{2 * np.pi / w[i2]: 8.6e} "
+                            f"{hd: 8.4e} {hd: 8.4e} {idof + 1} "
+                            f"{np.abs(F): 8.6e} {np.angle(F): 8.6e} "
+                            f"{F.real: 8.6e} {F.imag: 8.6e}\n")
+
+
 def _interp_heading(qtf, heads, beta):
     if len(heads) == 1:
         return qtf[:, :, 0, :]

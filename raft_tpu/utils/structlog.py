@@ -16,6 +16,7 @@ Events carry a monotonic ``t`` (seconds since process start) and a
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import sys
@@ -23,18 +24,30 @@ import time
 
 _T0 = time.perf_counter()
 _SINK = None
-_CHECKED = False
+_DEST = None
 
 
 def _sink():
-    global _SINK, _CHECKED
-    if not _CHECKED:
-        _CHECKED = True
-        dest = os.environ.get("RAFT_TPU_LOG", "")
+    """Resolve the sink from RAFT_TPU_LOG, re-reading the env var on
+    every call so setting/changing/unsetting it mid-process takes
+    effect (file handles are swapped and closed at interpreter exit).
+    The unset fast path is one dict lookup."""
+    global _SINK, _DEST
+    dest = os.environ.get("RAFT_TPU_LOG", "")
+    if dest != _DEST:
+        if _SINK is not None and _SINK is not sys.stderr:
+            try:
+                _SINK.close()
+            except Exception:
+                pass
+        _DEST = dest
         if dest == "-":
             _SINK = sys.stderr
         elif dest:
             _SINK = open(dest, "a")
+            atexit.register(_SINK.close)
+        else:
+            _SINK = None
     return _SINK
 
 

@@ -1,0 +1,79 @@
+"""MoorDyn file-format parsing: v1 vs v2 line-type column order.
+
+MoorDyn v2 line-type rows carry 10 columns
+(Name Diam Mass/m EA BA/-zeta EI Cd Ca CdAx CaAx); v1 rows carry 9 with
+the hydro coefficients added-mass-first (Name Diam MassDen EA BA/-zeta
+Can Cat Cdn Cdt).  Mapping v1 rows through the v2 positions silently
+swaps Cd<->Ca in the moorMod 1/2 dynamic-tension/impedance paths, so
+the parser must detect the format by column count (reference consumes
+these via MoorPy System.load, raft_fowt.py:359-370).
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.physics.mooring import parse_moordyn_system
+
+HEADER = """--------------------- MoorDyn Input File -------------------
+---------------------- LINE TYPES -----------------------------
+Name     Diam    MassDen   EA        BA/-zeta  {typecols}
+(name)   (m)     (kg/m)    (N)       (N-s/-)   {typeunits}
+{typerow}
+---------------------- POINTS ---------------------------------
+ID  Attachment  X       Y      Z     M  V  CdA Ca
+(#) (-)         (m)     (m)    (m)  (kg) (m3) (m2) (-)
+1   Fixed      -837.6   0.0   -200.0  0  0  0  0
+2   Vessel     -58.0    0.0   -14.0   0  0  0  0
+---------------------- LINES ----------------------------------
+ID  LineType  AttachA  AttachB  UnstrLen  NumSegs Outputs
+(#) (name)    (#)      (#)      (m)       (-)     (-)
+1   chain     1        2        850.0     40      -
+---------------------- OPTIONS --------------------------------
+0.001  dtM
+"""
+
+V2 = HEADER.format(
+    typecols="EI     Cd    Ca    CdAx   CaAx",
+    typeunits="(N-m^2) (-)  (-)   (-)    (-)",
+    typerow="chain   0.333   685.0   3.27e9    -1.0      0.0    1.1   0.82  0.21   0.27")
+
+V1 = HEADER.format(
+    typecols="Can   Cat    Cdn   Cdt",
+    typeunits="(-)   (-)    (-)   (-)",
+    typerow="chain   0.333   685.0   3.27e9    -1.0      0.82   0.27  1.1   0.21")
+
+AMBIG = HEADER.format(
+    typecols="Cd    Ca",
+    typeunits="(-)   (-)",
+    typerow="chain   0.333   685.0   3.27e9    -1.0      1.1    0.82")
+
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_v2_columns(tmp_path):
+    ms = parse_moordyn_system(_write(tmp_path, "v2.dat", V2), depth=200.0)
+    assert np.allclose(ms.Cd, 1.1)
+    assert np.allclose(ms.Ca, 0.82)
+    assert np.allclose(ms.CdAx, 0.21)
+    assert np.allclose(ms.CaAx, 0.27)
+
+
+def test_v1_columns_same_physics(tmp_path):
+    """The v1 file above carries the SAME physical coefficients as the
+    v2 one (Can=Ca, Cat=CaAx, Cdn=Cd, Cdt=CdAx) — the parsed system
+    must be identical."""
+    ms1 = parse_moordyn_system(_write(tmp_path, "v1.dat", V1), depth=200.0)
+    ms2 = parse_moordyn_system(_write(tmp_path, "v2.dat", V2), depth=200.0)
+    for attr in ("Cd", "Ca", "CdAx", "CaAx", "L", "w", "EA", "m_lin",
+                 "d_vol"):
+        np.testing.assert_allclose(getattr(ms1, attr), getattr(ms2, attr),
+                                   err_msg=attr)
+
+
+def test_ambiguous_column_count_raises(tmp_path):
+    with pytest.raises(ValueError, match="ambiguous line-type row"):
+        parse_moordyn_system(_write(tmp_path, "amb.dat", AMBIG), depth=200.0)

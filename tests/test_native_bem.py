@@ -212,6 +212,30 @@ def test_fd_green_series_vs_pv_integral():
             assert abs(gs - gr) / abs(gr) < 1e-7
 
 
+def test_fd_mode_count_tracks_panel_spacing():
+    """The evanescent mode count scales so the small-R extrapolation
+    cutoff Rc = 40 h / (pi n) stays at or below half the panel edge
+    scale — near-field accuracy must track mesh refinement instead of
+    being floored by the default 512 modes."""
+    import warnings
+
+    from raft_tpu.native import _fd_mode_count
+
+    h = 50.0
+    # coarse mesh (4 m panels): the default already resolves it
+    assert _fd_mode_count(h, np.array([16.0]), 512) == 512
+    # fine mesh (0.5 m panels): needs more modes; Rc <= d_panel/2
+    n = _fd_mode_count(h, np.array([0.25]), 512)
+    assert n > 512
+    assert 40.0 * h / (np.pi * n) <= 0.5 * 0.5 + 1e-9
+    # absurdly fine mesh: capped with a warning
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        n = _fd_mode_count(h, np.array([1e-4]), 512, n_cap=2048)
+    assert n == 2048
+    assert any("evanescent modes" in str(w.message) for w in rec)
+
+
 @pytest.mark.slow
 def test_fd_solver_shallow_energy_relation():
     """Genuinely shallow water (depth 12 m, K h ~ 0.5-2): the

@@ -54,6 +54,47 @@ def test_second_order_in_dynamics():
     assert model._last_drift_mean[0, 0] > 0
 
 
+def test_qtf_checkpoint_roundtrip(tmp_path):
+    """outFolderQTF (raft_fowt.py:434-436, 2027-2078): solve_dynamics
+    persists the slender-body QTF as WAMIT .12d and the motion RAOs as
+    .4, and reading the .12d back reproduces the in-memory QTF — the
+    reference's checkpoint pattern for expensive 2nd-order results."""
+    import glob
+
+    from raft_tpu.physics.secondorder import read_qtf_12d
+
+    path = ref_data("VolturnUS-S.yaml")
+    if not os.path.exists(path):
+        pytest.skip("reference data unavailable")
+    from raft_tpu.structure.schema import load_design
+
+    design = load_design(path)
+    design["platform"]["outFolderQTF"] = str(tmp_path)
+    model = raft_tpu.Model(design)
+    case = {"wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+            "turbine_status": "idle", "yaw_misalign": 0,
+            "wave_spectrum": "JONSWAP", "wave_period": 12, "wave_height": 6,
+            "wave_heading": 0, "current_speed": 0, "current_heading": 0}
+    model.solve_dynamics(case)
+
+    f12d = glob.glob(str(tmp_path / "qtf-slender_body-total_*.12d"))
+    f4 = glob.glob(str(tmp_path / "raos-slender_body_*.4"))
+    assert len(f12d) == 1 and len(f4) == 1
+    fs = model.fowtList[0]
+    back = read_qtf_12d(f12d[0], rho=fs.rho_water, g=fs.g)
+    np.testing.assert_allclose(back["w_2nd"], model.w1_2nd, rtol=1e-4)
+    # the solve's stored mean-drift force was computed from the same
+    # QTF that was written: re-deriving it from the FILE must match,
+    # closing the write->read->use loop
+    from raft_tpu.physics.secondorder import hydro_force_2nd
+
+    fh = model.hydro[0]
+    fm_back, _ = hydro_force_2nd(back, fh.beta[0], fh.S[0], model.w)
+    drift = np.asarray(model._last_drift_mean)[0, :6]
+    scale = max(np.abs(drift).max(), 1.0)
+    np.testing.assert_allclose(fm_back[:6], drift, atol=2e-4 * scale)
+
+
 def test_pinkster_iv_vectorized_matches_loop_and_scales():
     """The blocked-broadcast Pinkster-IV term equals the reference-style
     scalar double loop bitwise-compatibly, and handles a large
