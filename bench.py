@@ -596,9 +596,28 @@ def main():
     base_eval_s, base_host = _load_or_measure_baseline(
         max_measure_s=min(120.0, 0.15 * budget))
 
-    attempts = [("flat", 0.45), ("geom", 1.0)]
+    # backend health probe: a dead accelerator tunnel hangs jax init
+    # until killed (observed with the axon plugin), which would burn
+    # every attempt's deadline before the CPU fallback gets a turn.
+    # One tiny matmul with a generous timeout settles it up front.
+    device_ok = True
+    if not os.environ.get("RAFT_TPU_BENCH_PLATFORM"):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp; "
+                 "x = jnp.ones((128, 128)); (x @ x).block_until_ready(); "
+                 "print('ok', jax.devices()[0].device_kind)"],
+                timeout=float(os.environ.get("RAFT_TPU_BENCH_PROBE_S", "300")),
+                capture_output=True, text=True)
+            device_ok = p.returncode == 0
+        except subprocess.TimeoutExpired:
+            device_ok = False
+
+    attempts = [("flat", 0.45), ("geom", 0.8)] if device_ok else []
     results = {}
-    last_err = ""
+    last_err = ("" if device_ok
+                else "accelerator backend unavailable (health probe failed)")
     for mode, share in attempts:
         remaining = budget - (time.perf_counter() - t_start) - 10.0
         deadline = max(60.0, remaining * share)
@@ -630,6 +649,34 @@ def main():
         if mode in results:
             print(results[mode])
             return
+
+    # last resort: the accelerator backend may be unreachable (observed:
+    # axon tunnel down -> 'UNAVAILABLE: TPU backend setup/compile
+    # error' at init).  A CPU number explicitly labelled as such beats
+    # a third consecutive value=0 round; device_kind in the breakdown
+    # plus the note keep it honest.
+    if not os.environ.get("RAFT_TPU_BENCH_PLATFORM"):
+        remaining = budget - (time.perf_counter() - t_start) - 10.0
+        env = dict(os.environ, RAFT_TPU_BENCH_MODE="flat",
+                   RAFT_TPU_BENCH_PLATFORM="cpu",
+                   RAFT_TPU_BENCH_BASE_EVAL_S=repr(base_eval_s),
+                   RAFT_TPU_BENCH_BASE_HOST=base_host,
+                   RAFT_TPU_BENCH_DEADLINE_S=repr(max(60.0, remaining)))
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                timeout=max(60.0, remaining), capture_output=True, text=True)
+            for line in reversed((p.stdout or "").strip().splitlines()):
+                try:
+                    parsed = json.loads(line)
+                except Exception:
+                    continue
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    parsed["note"] = f"{last_err}; CPU-host fallback"
+                    print(json.dumps(parsed))
+                    return
+        except subprocess.TimeoutExpired:
+            pass
     print(json.dumps({
         "metric": "design-evals/sec/chip (VolturnUS-S, 100w x 12 cases)",
         "value": 0.0, "unit": "design-evals/s", "vs_baseline": 0.0,

@@ -109,7 +109,8 @@ def make_design_evaluator(model):
 
         Z, _, Bmat, dyn_diag = solve_dynamics_fowt(
             fs, ss, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
-            w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart)
+            w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart,
+            n_iter_extra=model.nIterExtra)
         F_wave = exc["F_hydro_iner"][0] + morison.drag_excitation(
             fs, ss, hc, Bmat, exc["u"][0], Tn, r_nodes)
         Xi = system_response(Z, F_wave[None])[0]
@@ -121,6 +122,33 @@ def make_design_evaluator(model):
         )
 
     return evaluate
+
+
+def case_to_traced(case, nWaves=1):
+    """Translate a parsed case-table row (reference key names,
+    docs/usage.rst:167) into the traced-evaluator case dict consumed by
+    :func:`make_full_evaluator` — scalar wind/current parameters plus
+    (nWaves,) sea-state arrays."""
+    from raft_tpu.structure.schema import coerce
+
+    turb = case.get("turbulence", 0.0)
+    TI = float(turb) if not isinstance(turb, str) else 0.0
+    return dict(
+        wind_speed=float(coerce(case, "wind_speed", shape=0, default=0.0)),
+        wind_heading_deg=float(coerce(case, "wind_heading", shape=0,
+                                      default=0.0)),
+        TI=TI,
+        yaw_misalign_deg=float(coerce(case, "yaw_misalign", shape=0,
+                                      default=0.0)),
+        current_speed=float(coerce(case, "current_speed", shape=0,
+                                   default=0.0)),
+        current_heading_deg=float(coerce(case, "current_heading", shape=0,
+                                         default=0.0)),
+        Hs=jnp.asarray(coerce(case, "wave_height", shape=nWaves), dtype=float),
+        Tp=jnp.asarray(coerce(case, "wave_period", shape=nWaves), dtype=float),
+        beta_deg=jnp.asarray(coerce(case, "wave_heading", shape=nWaves),
+                             dtype=float),
+    )
 
 
 def _interp_heading_traced(X_BEM, headings, beta_deg):
@@ -511,7 +539,8 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
 
         Z, _, Bmat, dyn_diag = solve_dynamics_fowt(
             fs, ss_t, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
-            w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart)
+            w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart,
+            n_iter_extra=model.nIterExtra)
 
         # ---- per-heading responses + zero rotor-source row
         # (reference leaves the rotor excitation row zero,
@@ -701,7 +730,8 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
             F_lin = exc["F_hydro_iner"][0]
             Z_i, _, Bmat, diag_i = solve_dynamics_fowt(
                 fs_i, sss[i], hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
-                w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart)
+                w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart,
+            n_iter_extra=model.nIterExtra)
             Z_blocks.append(Z_i)
             resids.append(diag_i["drag_resid"])
             for ih in range(nWaves):
@@ -929,7 +959,8 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None,
 
         Z, _, Bmat, dyn_diag = solve_dynamics_fowt(
             fs, ss_t, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
-            w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart)
+            w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart,
+            n_iter_extra=model.nIterExtra)
 
         def fwave_one(ih):
             F_drag = morison.drag_excitation(fs, ss_t, hc, Bmat, exc["u"][ih],
@@ -1003,6 +1034,7 @@ def make_case_evaluator(model, n_stat_iter=12):
         Z, Xi1, Bmat, dyn_diag = solve_dynamics_fowt(
             fs, ss, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
             w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart,
+            n_iter_extra=model.nIterExtra,
         )
         F_wave = F_lin * 0 + exc["F_hydro_iner"][0] + morison.drag_excitation(
             fs, ss, hc, Bmat, exc["u"][0], Tn, r_nodes

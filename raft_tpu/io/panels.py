@@ -326,15 +326,98 @@ def remove_interior_panels(verts, cents, norms, areas, members, owner):
     return verts[keep], cents[keep], norms[keep], areas[keep]
 
 
-def mesh_fowt(fs, dz_max=None, n_az=18, da_max=None, intersect=True):
+def _point_in_any(pts, members, skip):
+    """Inside-mask of ``pts`` against every member except index
+    ``skip``."""
+    inside = np.zeros(len(pts), dtype=bool)
+    for jm, mem in enumerate(members):
+        if jm == skip:
+            continue
+        inside |= point_in_member(pts, mem)
+    return inside
+
+
+def _subdivide_quad(q):
+    """Split one quad (4,3) into 4 sub-quads via edge midpoints and the
+    centroid (degenerate/triangle quads subdivide consistently)."""
+    m01 = 0.5 * (q[0] + q[1])
+    m12 = 0.5 * (q[1] + q[2])
+    m23 = 0.5 * (q[2] + q[3])
+    m30 = 0.5 * (q[3] + q[0])
+    c = 0.25 * (q[0] + q[1] + q[2] + q[3])
+    return np.stack([
+        np.stack([q[0], m01, c, m30]),
+        np.stack([m01, q[1], m12, c]),
+        np.stack([c, m12, q[2], m23]),
+        np.stack([m30, c, m23, q[3]]),
+    ])
+
+
+def clip_intersecting_panels(verts, norms, members, owner, max_depth=3):
+    """Re-mesh panels that CROSS member-intersection curves — the
+    functional core of the reference's boolean-union mesher
+    (IntersectionMesh.py:139): a panel with vertices on both sides of
+    another member's surface is recursively subdivided (midpoint
+    4-split) down to ``max_depth``, and sub-panels whose centroids fall
+    inside the other member are discarded.  The retained leaf panels
+    track the true intersection curve to O(panel_size / 2^max_depth),
+    eliminating both the double-counted interior portions and the
+    surface holes that whole-panel removal leaves at junctions
+    (e.g. OC4 column/pontoon joints, ``intersectMesh: 1`` designs).
+
+    Normal orientation is inherited from the parent panel (the meshers'
+    outward convention), not re-derived from winding.
+
+    Returns (vertices (P',4,3), centroids, normals, areas).
+    """
+    out = []
+    out_norm = []
+    for i in range(len(verts)):
+        im = int(owner[i])
+        stack = [(verts[i], 0)]
+        while stack:
+            q, depth = stack.pop()
+            vin = _point_in_any(q, members, im)
+            cent = q.mean(axis=0)[None, :]
+            cin = bool(_point_in_any(cent, members, im)[0])
+            if not vin.any() and not cin:
+                out.append(q)          # fully outside
+                out_norm.append(i)
+            elif vin.all() and cin:
+                continue               # fully interior: drop
+            elif depth >= max_depth:
+                if not cin:
+                    out.append(q)      # leaf: centroid rule
+                    out_norm.append(i)
+            else:
+                stack.extend((sq, depth + 1) for sq in _subdivide_quad(q))
+    if not out:
+        z = np.zeros((0, 4, 3))
+        return z, np.zeros((0, 3)), np.zeros((0, 3)), np.zeros(0)
+    verts2 = np.stack(out)
+    cents2, norms2, areas2 = _panel_geometry(verts2)
+    # orient each leaf like its parent panel (winding-derived sign can
+    # disagree with the mesher's outward normals)
+    parent_n = np.asarray([norms[j] for j in out_norm])
+    flip = np.sum(norms2 * parent_n, axis=1) < 0
+    norms2[flip] *= -1.0
+    return verts2, cents2, norms2, areas2
+
+
+def mesh_fowt(fs, dz_max=None, n_az=18, da_max=None, intersect=True,
+              clip_depth=3):
     """Combined wetted-surface panel mesh of a FOWT's potMod members at
     the reference pose (the calcBEM meshing stage,
     raft_fowt.py:1327-1344).  Members are meshed independently, as the
     reference's member2pnl does (no boolean union).
 
-    ``intersect``: drop panels lying inside other members (the
-    functional equivalent of the reference's boolean-union
-    IntersectionMesh path; raft_fowt.py:1346-1402).
+    ``intersect``: resolve member overlaps (the functional equivalent of
+    the reference's boolean-union IntersectionMesh path,
+    raft_fowt.py:1346-1402): whole panels inside other members are
+    dropped AND panels crossing intersection curves are re-meshed by
+    recursive subdivision-clipping (:func:`clip_intersecting_panels`)
+    to ``clip_depth`` levels; ``clip_depth=0`` recovers the
+    whole-panel-removal behaviour.
 
     Returns (vertices, centroids, normals, areas)."""
     vs, cs, ns, as_, owner = [], [], [], [], []
@@ -368,8 +451,13 @@ def mesh_fowt(fs, dz_max=None, n_az=18, da_max=None, intersect=True):
     norms = np.concatenate(ns)
     areas = np.concatenate(as_)
     if intersect:
-        verts, cents, norms, areas = remove_interior_panels(
-            verts, cents, norms, areas, fs.members, np.concatenate(owner))
+        own = np.concatenate(owner)
+        if clip_depth > 0:
+            verts, cents, norms, areas = clip_intersecting_panels(
+                verts, norms, fs.members, own, max_depth=clip_depth)
+        else:
+            verts, cents, norms, areas = remove_interior_panels(
+                verts, cents, norms, areas, fs.members, own)
     return verts, cents, norms, areas
 
 

@@ -37,7 +37,7 @@ def impedance(w, M, B, C):
 
 def solve_dynamics_fowt(
     fs, ss, hc, u0, M_lin, B_lin, C_lin, F_lin, w, Tn, r_nodes,
-    n_iter=15, Xi_start=0.1, tol=0.01, Z_extra=None,
+    n_iter=15, Xi_start=0.1, tol=0.01, Z_extra=None, n_iter_extra=0,
 ):
     """Iterative linearised solve for one FOWT's impedance and response.
 
@@ -69,6 +69,20 @@ def solve_dynamics_fowt(
         Xi = jnp.linalg.solve(Z, jnp.moveaxis(F, -1, 0)[..., None])[..., 0]
         return jnp.moveaxis(Xi, 0, -1), Z, Bmat  # (nDOF, nw)
 
+    # Iteration budget: the reference's cap is n_iter (break on
+    # convergence, warn otherwise, raft_model.py:1133-1143).  The
+    # default n_iter_extra=0 reproduces the reference EXACTLY, including
+    # its cap-limited states — the flexible-model goldens correspond to
+    # the capped fixed-point iterate (both cases of the flexible design,
+    # measured: enabling extra iterations moves the no-wind case off its
+    # 1e-10-level golden parity), so parity demands stopping where the
+    # reference stops even when the stopping rule is unmet (the
+    # flexible-tower wind case sits at residual ~1.03e-2 against tol
+    # 1e-2).  Sweeps that prefer the true fixed point over golden
+    # compatibility can grant n_iter_extra additional under-relaxed
+    # iterations, taken ONLY when the reference cap strikes unconverged.
+    cap = n_iter + 1 + max(int(n_iter_extra), 0)
+
     def body(carry):
         XiLast, it, _ = carry
         Xi, _, _ = update(XiLast)
@@ -77,16 +91,16 @@ def solve_dynamics_fowt(
         # keep the final LINEARISATION POINT: on convergence the
         # reference breaks before relaxing, and when the iteration cap
         # strikes it keeps the response computed at the last
-        # linearisation (raft_model.py:1133-1143) — relaxing once more
-        # before the final solve would be one extra iteration vs the
-        # reference (measured at ~1e-3 in cap-limited resonance bands)
-        last = it + 1 >= n_iter + 1
+        # linearisation — relaxing once more before the final solve
+        # would be one extra iteration vs the reference (measured at
+        # ~1e-3 in cap-limited resonance bands)
+        last = it + 1 >= cap
         XiNext = jnp.where(done | last, XiLast, 0.2 * XiLast + 0.8 * Xi)
         return XiNext, it + 1, done
 
     def cond(carry):
         _, it, done = carry
-        return (it < n_iter + 1) & (~done)
+        return (it < cap) & (~done)
 
     def run_fixed_point(f, Xinit):
         XiLast, _, _ = jax.lax.while_loop(cond, body, (Xinit, 0, jnp.asarray(False)))

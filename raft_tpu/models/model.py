@@ -33,6 +33,11 @@ class Model:
         settings = design.get("settings", {}) or {}
         self.XiStart = coerce(settings, "XiStart", default=0.1)
         self.nIter = int(coerce(settings, "nIter", default=15, dtype=int))
+        # optional extra under-relaxed iterations past the reference cap,
+        # taken only when unconverged (golden parity needs the default 0;
+        # see models/dynamics.py solve_dynamics_fowt)
+        self.nIterExtra = int(coerce(settings, "nIterExtra", default=0,
+                                     dtype=int))
 
         self.w = frequency_grid(design)
         self.nw = len(self.w)
@@ -490,6 +495,7 @@ class Model:
                 fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
                 jnp.asarray(self.w), fh.Tn, fh.r_nodes,
                 n_iter=self.nIter, Xi_start=self.XiStart, Z_extra=Z_moor,
+                n_iter_extra=self.nIterExtra,
             )
 
             # internally-computed slender-body QTFs (potSecOrder == 1):
@@ -538,6 +544,7 @@ class Model:
                     fs, fh.strips, fh.hc, fh.u[0], M_lin, B_lin, C_lin, F_lin,
                     jnp.asarray(self.w), fh.Tn, fh.r_nodes,
                     n_iter=self.nIter, Xi_start=self.XiStart, Z_extra=Z_moor,
+                n_iter_extra=self.nIterExtra,
                 )
             Z_blocks.append(Z_i)
             Bmats.append(Bmat)

@@ -12,14 +12,30 @@ through the aero damping and mean thrust, so motion PSDs are gated at
 
 Known golden anomalies (measured, documented rather than hidden):
 
-* The OC3 wind-case ``Tmoor_PSD`` golden has high-frequency content
-  that cannot be reproduced from the reference's own documented
-  moorMod-0 algorithm (tension Jacobian x motion amplitudes,
-  raft_fowt.py:2364-2368) using the golden's own stored motion RAs —
-  we match those RAs to 0.5% and the mean tensions to 1e-4, yet the
-  slack-line tension std differs ~30%, with the discrepancy growing
-  with frequency like a line-inertia term.  Tension spectra are
-  therefore gated loosely for the wind case.
+* The OC3 wind-case ``Tmoor_PSD`` golden — RESOLVED as a golden-side
+  Jacobian artifact (round 5, test_oc3_wind_tmoor_decomposition):
+  fitting a CONSTANT per-line-end tension Jacobian to the golden PSDs
+  using the golden's own stored motion RAs reproduces them to ~1e-14
+  relative, so the golden contains NO frequency-dependent (line-
+  dynamics) content at all — round 4's "line-inertia-like" reading was
+  wrong.  The fitted Jacobian's translational columns match our 0.1-m
+  central-secant catenary Jacobian to ~1e-4, but its roll/pitch
+  columns are 0.086-0.10x the true rotational derivative of the SAME
+  catenary tension function that reproduces the golden's mean tensions
+  to 1e-3 — an effective fairlead lever arm of ~7 m where the OC3
+  fairleads sit 70 m below the rotation point, inconsistent with any
+  rotation point of the platform (best-fit z* still leaves 92% error)
+  and with any finite-difference step size of the true catenary.  The
+  golden inherits this from the MoorPy build that generated it
+  (getCoupledStiffness(tensions=True) rotational columns; MoorPy is
+  not in this image to pin the exact defect).  Our rotational columns
+  are the physically-correct ones (the lumped-mass line dynamics
+  reduces to this same Jacobian at w -> 0,
+  tests/test_mooring_dynamics.py::test_quasi_static_tension_limit), so
+  the production path keeps them; the wind-case tension-spectrum gate
+  stays loose only because pitch response is significant there (the
+  no-wind case, where rotational contributions are negligible, matches
+  at 3e-5).
 * RESOLVED (round 4): the VolturnUS-S goldens' ~1.2e5 N mean surge
   force in the no-wind case is the slender-body-QTF mean drift fed back
   into the equilibrium — the reference re-runs solveStatics with
@@ -102,6 +118,75 @@ def test_analyze_cases_oc3_nowind():
     a = np.asarray(mc["Tmoor_PSD"])
     b = np.asarray(gc["Tmoor_PSD"])
     assert np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-12) < 0.5
+
+
+def test_oc3_wind_tmoor_decomposition():
+    """Quantified resolution of the OC3 wind-case Tmoor anomaly (see
+    module docstring).  Asserts, from the golden's own data:
+
+    1. the golden tension PSDs are EXACTLY a constant-Jacobian
+       realization of the golden's stored motion RAs (fit residual
+       < 1e-8 relative) — no frequency-dependent line-dynamics content;
+    2. the fitted Jacobian's translational columns match our catenary
+       tension Jacobian (same 0.1 central secant as MoorPy) to 5e-4;
+    3. the fitted rotational (roll/pitch) columns are 0.07-0.11x ours —
+       the golden-side artifact — while our mean tensions match the
+       golden to 1e-3, pinning our catenary as consistent with the
+       golden's own means.
+    """
+    from scipy.optimize import least_squares
+
+    import jax.numpy as jnp
+    from raft_tpu.models.outputs import mooring_tension_vector
+
+    path = ref_data("OC3spar.yaml")
+    if not os.path.exists(path):
+        pytest.skip("reference data unavailable")
+    with open(path.replace(".yaml", "_true_analyzeCases.pkl"), "rb") as f:
+        true = pickle.load(f)
+    gc = true["case_metrics"][1][0]
+    RAD = np.pi / 180.0
+    RA = np.stack(
+        [np.asarray(gc["surge_RA"]), np.asarray(gc["sway_RA"]),
+         np.asarray(gc["heave_RA"]), RAD * np.asarray(gc["roll_RA"]),
+         RAD * np.asarray(gc["pitch_RA"]), RAD * np.asarray(gc["yaw_RA"])],
+        axis=1)  # (nsources, 6, nw)
+    X0 = np.array(
+        [float(np.asarray(gc[c + "_avg"])) for c in ("surge", "sway", "heave")]
+        + [RAD * float(np.asarray(gc[c + "_avg"]))
+           for c in ("roll", "pitch", "yaw")])
+
+    model = raft_tpu.Model(path)
+    ms = model.ms_list[0]
+    dw = model.w[1] - model.w[0]
+    gpsd = np.asarray(gc["Tmoor_PSD"])
+
+    f = lambda x: np.asarray(mooring_tension_vector(ms, jnp.asarray(x)))
+    # (3) our catenary reproduces the golden mean tensions
+    np.testing.assert_allclose(f(X0), np.asarray(gc["Tmoor_avg"]), rtol=1e-3)
+
+    dx = 0.1
+    J = np.stack([(f(X0 + np.eye(6)[j] * dx) - f(X0 - np.eye(6)[j] * dx))
+                  / (2 * dx) for j in range(6)], axis=1)
+
+    def predict(Jt):
+        amps = np.einsum("j,hjw->hw", Jt, RA)
+        return np.sum(0.5 * np.abs(amps) ** 2 / dw, axis=0)
+
+    for iT in range(gpsd.shape[0]):
+        sol = least_squares(
+            lambda Jt: (predict(Jt) - gpsd[iT]) / gpsd[iT].max(),
+            J[iT], method="lm", max_nfev=20000)
+        # (1) constant Jacobian reproduces the golden exactly
+        assert np.abs(sol.fun).max() < 1e-8, iT
+        # (2) translational columns agree
+        np.testing.assert_allclose(sol.x[:3], J[iT, :3], rtol=5e-4,
+                                   err_msg=f"end {iT} translational")
+        # (3) rotational columns are the golden-side ~0.1x artifact
+        for j in (3, 4):
+            if abs(J[iT, j]) > 1e4:
+                ratio = sol.x[j] / J[iT, j]
+                assert 0.07 < ratio < 0.11, (iT, j, ratio)
 
 
 def test_analyze_cases_flexible_wind():

@@ -21,26 +21,10 @@ import numpy as np
 import pytest
 
 import raft_tpu
+from raft_tpu.api import case_to_traced as traced_case
 from raft_tpu.api import make_full_evaluator
-from raft_tpu.structure.schema import coerce
 
 EXAMPLES = "/root/reference/examples"
-
-
-def traced_case(case, nWaves=1):
-    turb = case.get("turbulence", 0.0)
-    TI = float(turb) if not isinstance(turb, str) else 0.0
-    return dict(
-        wind_speed=float(coerce(case, "wind_speed", shape=0, default=0.0)),
-        wind_heading_deg=float(coerce(case, "wind_heading", shape=0, default=0.0)),
-        TI=TI,
-        yaw_misalign_deg=float(coerce(case, "yaw_misalign", shape=0, default=0.0)),
-        current_speed=float(coerce(case, "current_speed", shape=0, default=0.0)),
-        current_heading_deg=float(coerce(case, "current_heading", shape=0, default=0.0)),
-        Hs=jnp.asarray(coerce(case, "wave_height", shape=nWaves), dtype=float),
-        Tp=jnp.asarray(coerce(case, "wave_period", shape=nWaves), dtype=float),
-        beta_deg=jnp.asarray(coerce(case, "wave_heading", shape=nWaves), dtype=float),
-    )
 
 
 def assert_parity(model, case, nWaves=1, rtol=1e-9):

@@ -187,9 +187,12 @@ def test_interior_panel_removal():
     fs = model.fowtList[0]
     v1, c1, n1, a1 = mesh_fowt(fs, dz_max=3.0, n_az=8, intersect=False)
     v2, c2, n2, a2 = mesh_fowt(fs, dz_max=3.0, n_az=8, intersect=True)
-    # OC4's pontoons/braces run into the columns: interior panels exist
-    assert len(a2) < len(a1)
-    assert len(a2) > 0.7 * len(a1)  # but most of the surface survives
+    # OC4's pontoons/braces run into the columns: the union surface is
+    # smaller than the sum of member surfaces (interior portions
+    # removed; junction panels are subdivided, so compare AREA, not
+    # panel count — clipping refines the mesh along intersection curves)
+    assert float(np.sum(a2)) < float(np.sum(a1))
+    assert float(np.sum(a2)) > 0.7 * float(np.sum(a1))
 
 
 def test_fd_green_series_vs_pv_integral():
