@@ -1,0 +1,121 @@
+"""North-star sweep demo (BASELINE.md): a 10k-design VolturnUS-S
+geometry DoE — 100 w-bins x the 12-case operating table per design —
+through the checkpointed sharded sweep
+(``raft_tpu.parallel.sweep.run_sweep_checkpointed_full``).
+
+This is the ``parametersweep.py:56-100`` workload done the TPU way: the
+reference mutates the design dict and re-builds/re-runs the whole model
+per variant (5 nested Python loops); here ONE compiled evaluator serves
+every design — geometry (member d/t scale, ballast fill, mooring
+length) enters the trace as parameters — and the design axis is sharded
+over the device mesh, checkpointed per shard, and resumable.
+
+Usage:
+    python sweep_10k.py [--n 10000] [--shard 512] [--out _sweep10k]
+
+Writes shard_NNNN.npz checkpoints plus SWEEP_10K.json with the
+throughput summary.  Re-running resumes from completed shards.
+"""
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=10000)
+    ap.add_argument("--shard", type=int, default=512)
+    ap.add_argument("--out", default="_sweep10k")
+    ap.add_argument("--platform", default=os.environ.get(
+        "RAFT_TPU_BENCH_PLATFORM", ""))
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    try:
+        jax.config.update("jax_compilation_cache_dir", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+    except Exception:
+        pass
+    import jax.numpy as jnp
+
+    import bench
+    from raft_tpu.parallel.sweep import make_mesh, run_sweep_checkpointed_full
+
+    model, evaluate = bench.build()       # geometry=True full evaluator
+    dw = model.w[1] - model.w[0]
+    case_cols = jnp.asarray(np.array(bench.CASES), dtype=jnp.float32)
+
+    def evaluate_design(d):
+        """One FULL design evaluation (12-case table) -> compact
+        per-design summary statistics (keeps shard files small)."""
+        g4 = d["g4"]
+        gc = evaluate.geometry_constants(dict(
+            d_scale=g4[0], t_scale=g4[1], fill_scale=g4[2],
+            L_moor_scale=g4[3]))
+
+        def one_case(c6):
+            out = evaluate(dict(
+                wind_speed=c6[0], wind_heading_deg=c6[1], TI=c6[2],
+                Hs=c6[3], Tp=c6[4], beta_deg=c6[5], geom_const=gc))
+            std = jnp.sqrt(jnp.sum(out["PSD"][:6] * dw, axis=-1))  # (6,)
+            return dict(X0=out["X0"][:6], std=std,
+                        drag_resid=out["drag_resid"])
+
+        per_case = jax.vmap(one_case)(case_cols)   # (12, ...)
+        x0 = per_case["X0"]
+        std = per_case["std"]
+        return dict(
+            max_offset=jnp.max(jnp.hypot(x0[:, 0] + 3 * std[:, 0],
+                                         x0[:, 1] + 3 * std[:, 1])),
+            max_pitch_deg=jnp.rad2deg(
+                jnp.max(jnp.abs(x0[:, 4]) + 3 * std[:, 4])),
+            surge_std=std[:, 0], pitch_std=std[:, 4],
+            X0=x0, drag_resid=jnp.max(per_case["drag_resid"]),
+        )
+
+    g4 = bench.sample_geometry(args.n, seed=11).astype(np.float32)
+    mesh = make_mesh()
+    print(f"devices: {mesh.devices.size} x "
+          f"{jax.devices()[0].device_kind}; {args.n} designs "
+          f"(100w x {len(bench.CASES)} cases each)", flush=True)
+
+    t0 = time.perf_counter()
+    out = run_sweep_checkpointed_full(
+        evaluate_design, {"g4": g4}, args.out, shard_size=args.shard,
+        mesh=mesh,
+        out_keys=("max_offset", "max_pitch_deg", "surge_std", "pitch_std",
+                  "X0", "drag_resid"))
+    wall = time.perf_counter() - t0
+
+    n_done = len(out["max_offset"])
+    summary = dict(
+        n_designs=int(n_done),
+        cases_per_design=len(bench.CASES),
+        n_freq=int(model.nw),
+        wall_s=round(wall, 2),
+        design_evals_per_s=round(n_done / wall, 3),
+        device_kind=jax.devices()[0].device_kind,
+        n_devices=int(mesh.devices.size),
+        shard_size=args.shard,
+        out_dir=args.out,
+        max_offset_range=[float(np.min(out["max_offset"])),
+                          float(np.max(out["max_offset"]))],
+        max_pitch_range=[float(np.min(out["max_pitch_deg"])),
+                         float(np.max(out["max_pitch_deg"]))],
+        worst_drag_resid=float(np.max(out["drag_resid"])),
+    )
+    with open("SWEEP_10K.json", "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
