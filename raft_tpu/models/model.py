@@ -21,8 +21,12 @@ from raft_tpu.ops.waves import wave_number_ref
 
 
 class Model:
-    def __init__(self, design):
-        self.base_dir = None
+    def __init__(self, design, base_dir=None):
+        """``base_dir``: directory for resolving relative data paths
+        (MoorDyn files, WAMIT coefficients) when ``design`` is an
+        already-loaded dict; inferred from the file location when
+        ``design`` is a path."""
+        self.base_dir = base_dir
         if isinstance(design, str):
             import os
 

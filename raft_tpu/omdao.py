@@ -39,8 +39,15 @@ class DesignEvaluation:
     (tests/test_omdao.py pins evaluator-vs-host metric parity)."""
 
     def __init__(self, base_design, use_traced=True):
+        import os
+
         from raft_tpu.structure.schema import load_design
 
+        # remember the source directory so relative data paths (MoorDyn
+        # files, WAMIT coefficients) keep resolving after the design is
+        # deep-copied as a dict
+        self._base_dir = (os.path.dirname(os.path.abspath(base_design))
+                          if isinstance(base_design, str) else None)
         self.base_design = load_design(base_design)
         self.use_traced = use_traced
         self._fast = None   # lazily: (model, jitted evaluate | None)
@@ -49,23 +56,33 @@ class DesignEvaluation:
 
     def _fast_model(self):
         """Cached (model, evaluate) for the base design; evaluate is
-        None when the design is outside the traced evaluator's domain
-        (farm, flexible, multi-heading cases)."""
+        None when the design is outside the traced evaluators' domain
+        (multi-heading cases, potential-flow/QTF farms, ...), in which
+        case the host path serves as the fallback."""
         if self._fast is not None:
             return self._fast
         import jax
 
         import raft_tpu
-        from raft_tpu.api import make_full_evaluator
+        from raft_tpu.api import (make_farm_evaluator, make_flexible_evaluator,
+                                  make_full_evaluator)
 
-        model = raft_tpu.Model(copy.deepcopy(self.base_design))
+        model = raft_tpu.Model(copy.deepcopy(self.base_design),
+                               base_dir=self._base_dir)
         evaluate = None
         fs = model.fowtList[0]
         single_heading = all(
             np.ndim(c.get("wave_heading", 0.0)) == 0 for c in model.cases)
-        if (self.use_traced and model.nFOWT == 1 and fs.is_single_body
-                and single_heading):
-            evaluate = jax.jit(make_full_evaluator(model))
+        if self.use_traced and single_heading:
+            try:
+                if model.nFOWT > 1:
+                    evaluate = jax.jit(make_farm_evaluator(model))
+                elif fs.is_single_body:
+                    evaluate = jax.jit(make_full_evaluator(model))
+                else:
+                    evaluate = jax.jit(make_flexible_evaluator(model))
+            except (AssertionError, ValueError):
+                evaluate = None   # outside the traced domain: host path
         self._fast = (model, evaluate)
         return self._fast
 
@@ -77,17 +94,24 @@ class DesignEvaluation:
         from raft_tpu.models.outputs import turbine_outputs
 
         model.results = {"case_metrics": {}, "mean_offsets": []}
+        offs = model.dof_offsets
         for iCase, case in enumerate(model.cases):
             out = evaluate(case_to_traced(case))
-            tc = model.turbine_constants(case)
-            metrics = turbine_outputs(
-                model, case, np.asarray(out["X0"]), np.asarray(out["Xi"]),
-                np.asarray(out["S"]), np.asarray(out["zeta"]),
-                A_aero=np.asarray(tc["A00"]).T, B_aero=np.asarray(tc["B00"]).T,
-                f_aero0=tc["f_aero0"], ifowt=0,
-                rotor_info=tc.get("rotor_info"))
-            model.results["case_metrics"][iCase] = {0: metrics}
-            model.results["mean_offsets"].append(np.asarray(out["X0"]))
+            X0 = np.asarray(out["X0"])
+            Xi = np.asarray(out["Xi"])
+            model.results["case_metrics"][iCase] = {}
+            for i in range(model.nFOWT):
+                tc = model.turbine_constants(case, ifowt=i)
+                metrics = turbine_outputs(
+                    model, case, X0[offs[i]:offs[i + 1]],
+                    Xi[:, offs[i]:offs[i + 1], :],
+                    np.asarray(out["S"]), np.asarray(out["zeta"]),
+                    A_aero=np.asarray(tc["A00"]).T,
+                    B_aero=np.asarray(tc["B00"]).T,
+                    f_aero0=tc["f_aero0"], ifowt=i,
+                    rotor_info=tc.get("rotor_info"))
+                model.results["case_metrics"][iCase][i] = metrics
+            model.results["mean_offsets"].append(X0)
         return model.results
 
     def compute(self, overrides=None):
@@ -119,7 +143,7 @@ class DesignEvaluation:
                 else:
                     node[k] = value
 
-            model = raft_tpu.Model(design)
+            model = raft_tpu.Model(design, base_dir=self._base_dir)
             model.analyze_cases()
         stat = model.statics(0)
 
