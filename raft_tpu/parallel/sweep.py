@@ -94,13 +94,18 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
 
 def run_sweep_checkpointed_full(evaluate, cases, out_dir, shard_size=256,
                                 mesh=None, out_keys=("PSD", "X0"),
-                                shard_freq=False):
+                                shard_freq=False, on_shard=None):
     """Checkpointed full-physics sweep over a case/design dict.
 
     Generalizes :func:`run_sweep_checkpointed` to the full evaluator's
     case dict (VERDICT r2 weak #5): each shard of the (N,)-array batch
     runs as one sharded program and lands in ``shard_NNNN.npz``;
     re-running skips completed shards (resume after preemption).
+
+    ``on_shard(done, total, fresh)``: optional progress callback after
+    each shard (``fresh`` False when the shard was resumed from disk) —
+    lets long sweeps persist incremental summaries so a preempted run
+    still leaves an auditable artifact.
     """
     import os
 
@@ -117,6 +122,8 @@ def run_sweep_checkpointed_full(evaluate, cases, out_dir, shard_size=256,
         path = os.path.join(out_dir, f"shard_{s:04d}.npz")
         if os.path.exists(path):
             results.append(dict(np.load(path)))
+            if on_shard is not None:
+                on_shard(s + 1, n_shards, False)
             continue
         sl = slice(s * shard_size, min((s + 1) * shard_size, n))
         chunk = {k: v[sl] for k, v in cases.items()}
@@ -129,6 +136,8 @@ def run_sweep_checkpointed_full(evaluate, cases, out_dir, shard_size=256,
         out = {k: np.asarray(v)[: sl.stop - sl.start] for k, v in out.items()}
         np.savez(path, **out)
         results.append(out)
+        if on_shard is not None:
+            on_shard(s + 1, n_shards, True)
 
     return {k: np.concatenate([r[k] for r in results]) for k in out_keys}
 

@@ -88,11 +88,33 @@ def main():
           f"(100w x {len(bench.CASES)} cases each)", flush=True)
 
     t0 = time.perf_counter()
+    n_fresh = [0]
+
+    def on_shard(done, total, fresh):
+        """Incremental progress summary: a preempted run still leaves
+        SWEEP_10K.json covering the completed shards."""
+        n_fresh[0] += int(fresh)
+        el = time.perf_counter() - t0
+        rate = (n_fresh[0] * args.shard) / max(el, 1e-9)
+        prog = dict(
+            status="running" if done < total else "complete",
+            shards_done=done, shards_total=total,
+            designs_done=min(done * args.shard, args.n),
+            wall_s=round(el, 2),
+            design_evals_per_s_fresh=round(rate, 3),
+            device_kind=jax.devices()[0].device_kind,
+            n_devices=int(mesh.devices.size), out_dir=args.out)
+        with open("SWEEP_10K.json", "w") as f:
+            json.dump(prog, f, indent=1)
+        print(f"shard {done}/{total} ({'fresh' if fresh else 'resumed'}), "
+              f"{rate:.3f} evals/s", flush=True)
+
     out = run_sweep_checkpointed_full(
         evaluate_design, {"g4": g4}, args.out, shard_size=args.shard,
         mesh=mesh,
         out_keys=("max_offset", "max_pitch_deg", "surge_std", "pitch_std",
-                  "X0", "drag_resid"))
+                  "X0", "drag_resid"),
+        on_shard=on_shard)
     wall = time.perf_counter() - t0
 
     n_done = len(out["max_offset"])
