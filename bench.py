@@ -516,15 +516,21 @@ def _load_or_measure_baseline(max_measure_s):
     fresh measurement only happens if the artifact is missing, runs in
     the parent *before* any attempt deadline, uses NBASE=1 by default,
     and refreshes the artifact for next time."""
+    import platform
     import socket
 
+    fingerprint = dict(host=socket.gethostname(),
+                       cpu=platform.processor() or platform.machine(),
+                       cpu_count=os.cpu_count())
     try:
         with open(BASELINE_ARTIFACT) as f:
             art = json.load(f)
-        # the artifact is only valid on the host that measured it —
+        # the artifact is only valid on the machine that measured it —
         # reusing a baseline from a different machine would make
-        # vs_baseline a cross-host ratio
-        if art.get("host") == socket.gethostname():
+        # vs_baseline a cross-host ratio.  Hostname alone is a weak
+        # fingerprint (generic names like 'vm'), so the cpu fields
+        # must match too.
+        if all(art.get(k) == v for k, v in fingerprint.items()):
             return (float(art["design_eval_s"]),
                     art.get("host", "?") + " (artifact)")
     except Exception:
@@ -545,17 +551,16 @@ def _load_or_measure_baseline(max_measure_s):
         if time.perf_counter() - t_all0 > max_measure_s:
             break
     design_eval_s = float(np.mean(times)) * len(CASES)
-    host = socket.gethostname()
     try:
         with open(BASELINE_ARTIFACT, "w") as f:
             json.dump(dict(design_eval_s=design_eval_s,
                            case_s_mean=float(np.mean(times)),
-                           n_measured=len(times), host=host,
+                           n_measured=len(times), **fingerprint,
                            workload="VolturnUS-S 100w x 12 cases, serial "
                                     "NumPy twin (bench.numpy_eval_case)"), f)
     except Exception:
         pass
-    return design_eval_s, host
+    return design_eval_s, fingerprint["host"]
 
 
 def _baseline_model():

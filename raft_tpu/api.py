@@ -146,9 +146,31 @@ def case_to_traced(case, nWaves=1):
                                          default=0.0)),
         Hs=jnp.asarray(coerce(case, "wave_height", shape=nWaves), dtype=float),
         Tp=jnp.asarray(coerce(case, "wave_period", shape=nWaves), dtype=float),
+        gamma=jnp.asarray(coerce(case, "wave_gamma", shape=nWaves,
+                                 default=0.0), dtype=float),
         beta_deg=jnp.asarray(coerce(case, "wave_heading", shape=nWaves),
                              dtype=float),
     )
+
+
+def case_in_traced_domain(case):
+    """True when a parsed case row is inside the traced evaluators'
+    STATIC assumptions: operating turbine, JONSWAP seas, numeric
+    turbulence intensity, one wave heading.  IEC turbulence-class
+    strings ('IB_NTM', ...), parked/idle rotors and unit/still spectra
+    are resolved by the host path's per-case branching
+    (models/model.py:337-348, models/hydro.py:39-49) which the traced
+    build bakes in — routing such cases through the trace would
+    silently evaluate different physics."""
+    if isinstance(case.get("turbulence", 0.0), str):
+        return False
+    if str(case.get("turbine_status", "operating")) != "operating":
+        return False
+    spec = case.get("wave_spectrum", "JONSWAP")
+    specs = [spec] if isinstance(spec, str) else list(np.atleast_1d(spec))
+    if any(str(s).upper() not in ("JONSWAP",) for s in specs):
+        return False
+    return np.ndim(case.get("wave_heading", 0.0)) == 0
 
 
 def _interp_heading_traced(X_BEM, headings, beta_deg):

@@ -370,11 +370,32 @@ def clip_intersecting_panels(verts, norms, members, owner, max_depth=3):
 
     Returns (vertices (P',4,3), centroids, normals, areas).
     """
-    out = []
-    out_norm = []
-    for i in range(len(verts)):
+    verts = np.asarray(verts)
+    P = len(verts)
+    # batched prefilter: classify every parent panel in O(n_members)
+    # vectorised passes (the remove_interior_panels pattern) so the
+    # Python recursion below only touches the small set of panels that
+    # genuinely cross another member's surface
+    vin_all = np.zeros((P, 4), dtype=bool)
+    cin_all = np.zeros(P, dtype=bool)
+    cents0 = verts.mean(axis=1)
+    for jm, mem in enumerate(members):
+        rows = owner != jm
+        if not np.any(rows):
+            continue
+        vin_all[rows] |= point_in_member(
+            verts[rows].reshape(-1, 3), mem).reshape(-1, 4)
+        cin_all[rows] |= point_in_member(cents0[rows], mem)
+    keep_whole = ~vin_all.any(axis=1) & ~cin_all
+    drop_whole = vin_all.all(axis=1) & cin_all
+    crossing = ~keep_whole & ~drop_whole
+
+    out = [verts[i] for i in np.nonzero(keep_whole)[0]]
+    out_norm = list(np.nonzero(keep_whole)[0])
+    for i in np.nonzero(crossing)[0]:
         im = int(owner[i])
-        stack = [(verts[i], 0)]
+        stack = [(sq, 1) for sq in _subdivide_quad(verts[i])] \
+            if max_depth > 0 else [(verts[i], 0)]
         while stack:
             q, depth = stack.pop()
             vin = _point_in_any(q, members, im)

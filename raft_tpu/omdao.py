@@ -64,16 +64,16 @@ class DesignEvaluation:
         import jax
 
         import raft_tpu
-        from raft_tpu.api import (make_farm_evaluator, make_flexible_evaluator,
+        from raft_tpu.api import (case_in_traced_domain, make_farm_evaluator,
+                                  make_flexible_evaluator,
                                   make_full_evaluator)
 
         model = raft_tpu.Model(copy.deepcopy(self.base_design),
                                base_dir=self._base_dir)
         evaluate = None
         fs = model.fowtList[0]
-        single_heading = all(
-            np.ndim(c.get("wave_heading", 0.0)) == 0 for c in model.cases)
-        if self.use_traced and single_heading:
+        in_domain = all(case_in_traced_domain(c) for c in model.cases)
+        if self.use_traced and in_domain:
             try:
                 if model.nFOWT > 1:
                     evaluate = jax.jit(make_farm_evaluator(model))

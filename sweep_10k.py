@@ -118,12 +118,19 @@ def main():
     wall = time.perf_counter() - t0
 
     n_done = len(out["max_offset"])
+    # throughput counts only FRESHLY computed shards: a resumed re-run
+    # loads shards from disk in seconds and must not overwrite the
+    # artifact with a bogus thousands-of-evals/s headline
+    fresh_designs = min(n_fresh[0] * args.shard, n_done)
     summary = dict(
         n_designs=int(n_done),
         cases_per_design=len(bench.CASES),
         n_freq=int(model.nw),
         wall_s=round(wall, 2),
-        design_evals_per_s=round(n_done / wall, 3),
+        design_evals_per_s=(round(fresh_designs / wall, 3)
+                            if fresh_designs else None),
+        fresh_designs=int(fresh_designs),
+        resumed_designs=int(n_done - fresh_designs),
         device_kind=jax.devices()[0].device_kind,
         n_devices=int(mesh.devices.size),
         shard_size=args.shard,
