@@ -274,6 +274,9 @@ def test_oc3_wind_error_budget():
     assert np.all(vals < 0.45), implied      # < 0.45% mean-load dev
     assert np.all(vals > 0.05), implied      # and not accidentally zero
     assert vals.max() / vals.min() < 4.0, implied  # consistent across ch.
+
+
+def test_analyze_cases_flexible_wind():
     """VolturnUS-S-flexible analyzeCases parity — BOTH cases, including
     the 10 m/s operating-turbine case through the aero-servo chain on a
     flexible-tower (multibody) model.
