@@ -604,20 +604,14 @@ def main():
     # backend health probe: a dead accelerator tunnel hangs jax init
     # until killed (observed with the axon plugin), which would burn
     # every attempt's deadline before the CPU fallback gets a turn.
-    # One tiny matmul with a generous timeout settles it up front.
+    # One tiny matmul in a subprocess with a generous timeout settles it
+    # up front (shared with the sweep runtime's CPU-fallback logic).
     device_ok = True
     if not os.environ.get("RAFT_TPU_BENCH_PLATFORM"):
-        try:
-            p = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax, jax.numpy as jnp; "
-                 "x = jnp.ones((128, 128)); (x @ x).block_until_ready(); "
-                 "print('ok', jax.devices()[0].device_kind)"],
-                timeout=float(os.environ.get("RAFT_TPU_BENCH_PROBE_S", "300")),
-                capture_output=True, text=True)
-            device_ok = p.returncode == 0
-        except subprocess.TimeoutExpired:
-            device_ok = False
+        from raft_tpu.utils.devices import probe_backend
+
+        device_ok = probe_backend(timeout_s=float(
+            os.environ.get("RAFT_TPU_BENCH_PROBE_S", "300")))
 
     attempts = [("flat", 0.45), ("geom", 0.8)] if device_ok else []
     results = {}

@@ -1,0 +1,12 @@
+"""Device-mesh sweep drivers and the fault-tolerant sweep runtime.
+
+``raft_tpu.parallel.sweep``       GSPMD sweep drivers (vmap + shardings)
+``raft_tpu.parallel.resilience``  atomic checkpoints, manifest-validated
+                                  resume, retry/backoff, NaN quarantine
+"""
+
+from raft_tpu.parallel.resilience import (  # noqa: F401
+    ManifestMismatchError, ShardCorruptError, load_quarantine)
+from raft_tpu.parallel.sweep import (  # noqa: F401
+    make_mesh, run_sweep_checkpointed, run_sweep_checkpointed_full,
+    sweep_cases, sweep_cases_full)

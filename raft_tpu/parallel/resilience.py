@@ -1,0 +1,561 @@
+"""Fault-tolerant runtime for checkpointed sweeps.
+
+The north-star workload (10k+ designs x 12 cases, hours of wall time,
+20+ checkpoint shards) fails in ways the happy-path driver in
+:mod:`raft_tpu.parallel.sweep` used to ignore: a preemption mid-write
+leaves a truncated ``.npz`` that poisons the resume; a resumed run with
+*changed* inputs silently mixes stale shards into fresh results; one
+non-converged drag linearization emits a NaN row that propagates into
+every aggregate; and a dead accelerator tunnel kills the whole sweep
+instead of degrading to the CPU backend.  This module supplies the
+missing pieces:
+
+* **atomic shard writes** — tmp file in the same directory +
+  ``os.replace``, so a shard file either exists complete or not at all;
+* **corrupt-shard detection** — resume loads with
+  ``np.load(allow_pickle=False)``, verifies the stored keys cover the
+  requested ``out_keys`` and row counts match, and re-queues (never
+  crashes on) a truncated/corrupt/stale shard;
+* **sweep manifest** — ``manifest.json`` per ``out_dir`` records a
+  config fingerprint (case-array hashes, ``out_keys``, ``shard_size``,
+  mesh shape, package version) plus per-shard status; resuming against
+  a manifest whose *input-determining* fields differ raises
+  :class:`ManifestMismatchError` instead of mixing stale data;
+* **retry with exponential backoff** for transient evaluator/runtime
+  errors, OOM degradation by halving the shard batch, and CPU-backend
+  fallback when :func:`raft_tpu.utils.devices.probe_backend` says the
+  accelerator is unhealthy;
+* **NaN/Inf quarantine** — a per-row finiteness check after each shard;
+  offending case parameters land in ``quarantine.json`` (with an
+  optional solo re-evaluation on the CPU backend) so non-finite rows
+  are auditable instead of silently poisoning aggregates.
+
+Every event flows through :mod:`raft_tpu.utils.structlog` (JSONL):
+``sweep_start``, ``shard_start``, ``shard_done``, ``shard_resume``,
+``shard_corrupt``, ``shard_retry``, ``shard_oom_split``,
+``shard_quarantine``, ``backend_fallback``, ``manifest_mismatch``,
+``sweep_done``.  Failure paths are exercised deterministically via
+:mod:`raft_tpu.utils.faults`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+from raft_tpu.utils import faults
+from raft_tpu.utils.structlog import log_event
+
+MANIFEST_NAME = "manifest.json"
+QUARANTINE_NAME = "quarantine.json"
+
+# fingerprint fields that determine the numerical content and layout of
+# the shard files; any difference on resume means the existing shards
+# answer a different question and must not be mixed in
+_STRICT_FINGERPRINT_KEYS = ("case_hashes", "n_cases", "out_keys", "shard_size")
+
+
+class ManifestMismatchError(RuntimeError):
+    """Resume attempted with inputs that differ from the manifest."""
+
+
+class ShardCorruptError(RuntimeError):
+    """A checkpoint shard failed to load or failed validation."""
+
+
+# --------------------------------------------------------------- atomic I/O
+
+
+def _atomic_write(path, writer, mode="wb"):
+    """Write a file atomically: tmp file in the same dir, ``writer(f)``,
+    then ``os.replace`` — atomic on POSIX within one filesystem, so a
+    preempted/killed process leaves either the complete previous file or
+    no file, never a truncated one at the final path."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, mode) as f:
+            writer(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_savez(path, **arrays):
+    """Write an ``.npz`` atomically (tmp file + rename)."""
+    _atomic_write(path, lambda f: np.savez(f, **arrays))
+    if faults.take("truncate", "shard_write"):
+        # simulate dying mid-write on a pre-atomic driver / a filesystem
+        # that lost the tail: corrupt the final file, then "crash"
+        faults.truncate_file(path)
+        raise faults.InjectedFault(f"injected truncation of {path}")
+
+
+def _atomic_json(path, obj):
+    _atomic_write(path, lambda f: json.dump(obj, f, indent=1, default=str),
+                  mode="w")
+
+
+def load_shard(path, out_keys, expect_rows=None):
+    """Load and validate one checkpoint shard.
+
+    Loads with ``allow_pickle=False`` (checkpoints are plain arrays; a
+    pickled object in one is corruption or tampering), verifies every
+    requested output key is present, and optionally checks the row
+    count.  Raises :class:`ShardCorruptError` on any failure so the
+    caller can re-queue the shard instead of crashing."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            stored = set(z.files)
+            missing = [k for k in out_keys if k not in stored]
+            if missing:
+                raise ShardCorruptError(
+                    f"{path}: stored keys {sorted(stored)} missing "
+                    f"requested out_keys {missing}")
+            out = {k: z[k] for k in out_keys}
+    except ShardCorruptError:
+        raise
+    except Exception as e:  # truncated zip, bad CRC, unreadable header...
+        raise ShardCorruptError(f"{path}: failed to load ({e})") from e
+    if expect_rows is not None:
+        bad = {k: v.shape for k, v in out.items()
+               if v.shape[:1] != (expect_rows,)}
+        if bad:
+            raise ShardCorruptError(
+                f"{path}: expected {expect_rows} rows, got {bad}")
+    return out
+
+
+# ----------------------------------------------------------------- manifest
+
+
+def compute_fingerprint(cases, out_keys, shard_size, mesh):
+    """Config fingerprint of one checkpointed sweep.
+
+    ``case_hashes`` digests each case array's dtype+shape+bytes, so any
+    change to the inputs — values, order, length — changes the
+    fingerprint.  Mesh shape and package version are recorded for audit
+    but compared only advisorily (results do not depend on device
+    layout)."""
+    import raft_tpu
+
+    case_hashes = {}
+    for k in sorted(cases):
+        v = np.ascontiguousarray(cases[k])
+        h = hashlib.sha256()
+        h.update(str(v.dtype).encode())
+        h.update(str(v.shape).encode())
+        h.update(v.tobytes())
+        case_hashes[k] = h.hexdigest()
+    return {
+        "case_hashes": case_hashes,
+        "n_cases": int(len(next(iter(cases.values())))),
+        "out_keys": list(out_keys),
+        "shard_size": int(shard_size),
+        "mesh_shape": [int(s) for s in mesh.devices.shape],
+        "mesh_axes": list(mesh.axis_names),
+        "package_version": getattr(raft_tpu, "__version__", "unknown"),
+    }
+
+
+def _manifest_path(out_dir):
+    return os.path.join(out_dir, MANIFEST_NAME)
+
+
+def init_manifest(out_dir, fingerprint, n_shards):
+    """Create or validate the sweep manifest for ``out_dir``.
+
+    First run: writes a fresh manifest.  Resume: the strict fingerprint
+    fields must match or :class:`ManifestMismatchError` is raised —
+    changed inputs silently mixed with stale shards is the one failure
+    mode this layer exists to make loud.  Advisory fields (mesh shape,
+    package version) only log a ``manifest_mismatch`` warning event.
+
+    Returns the manifest dict (fresh or loaded)."""
+    path = _manifest_path(out_dir)
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+            old = manifest["fingerprint"]
+        except Exception as e:
+            raise ManifestMismatchError(
+                f"{path} exists but is unreadable ({e}); refusing to "
+                "resume against an unvalidatable checkpoint directory — "
+                "delete the directory to start fresh") from e
+        mismatched = [k for k in _STRICT_FINGERPRINT_KEYS
+                      if old.get(k) != fingerprint[k]]
+        if mismatched:
+            log_event("manifest_mismatch", out_dir=out_dir,
+                      fields=mismatched, fatal=True)
+            raise ManifestMismatchError(
+                f"resume fingerprint mismatch in {path} on fields "
+                f"{mismatched}: the existing shards were produced from "
+                "different inputs/config and cannot be mixed with this "
+                "sweep — use a fresh out_dir (or delete this one)")
+        advisory = [k for k in ("mesh_shape", "mesh_axes", "package_version")
+                    if old.get(k) != fingerprint[k]]
+        # adopt current advisory fields, keep shard statuses; persist so
+        # the advisory mismatch is logged once, not on every resume
+        manifest["fingerprint"] = fingerprint
+        manifest.setdefault("shards", {})
+        if advisory:
+            log_event("manifest_mismatch", out_dir=out_dir,
+                      fields=advisory, fatal=False)
+            _atomic_json(path, manifest)
+        return manifest
+    manifest = {
+        "version": 1,
+        "fingerprint": fingerprint,
+        "n_shards": int(n_shards),
+        "shards": {},
+    }
+    _atomic_json(path, manifest)
+    return manifest
+
+
+def mark_shard(manifest, out_dir, shard, status, **extra):
+    """Record one shard's status in the manifest (atomic rewrite)."""
+    rec = {"status": status, "file": f"shard_{shard:04d}.npz"}
+    rec.update(extra)
+    manifest["shards"][str(shard)] = rec
+    _atomic_json(_manifest_path(out_dir), manifest)
+
+
+# --------------------------------------------------------------- quarantine
+
+
+def _quarantine_path(out_dir):
+    return os.path.join(out_dir, QUARANTINE_NAME)
+
+
+def record_quarantine(out_dir, shard, entries):
+    """Merge quarantine ``entries`` for one shard into quarantine.json.
+
+    Entries for the same shard from an earlier (superseded) computation
+    are replaced, so a recomputed shard re-judges its own rows."""
+    path = _quarantine_path(out_dir)
+    existing = []
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                existing = json.load(f).get("entries", [])
+        except Exception as e:
+            # externally damaged audit file: resetting it silently would
+            # erase every prior entry — leave a loud trace first
+            log_event("quarantine_corrupt", out_dir=out_dir,
+                      error=str(e)[:200])
+            existing = []
+    existing = [e for e in existing if e.get("shard") != shard]
+    existing.extend(entries)
+    existing.sort(key=lambda e: (e.get("shard", 0), e.get("index", 0)))
+    _atomic_json(path, {"version": 1, "entries": existing})
+
+
+def load_quarantine(out_dir):
+    """Return the list of quarantine entries for ``out_dir`` ([] if none)."""
+    path = _quarantine_path(out_dir)
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            return json.load(f).get("entries", [])
+    except Exception as e:
+        log_event("quarantine_corrupt", out_dir=out_dir, error=str(e)[:200])
+        return []
+
+
+def nonfinite_rows(out):
+    """Indices of batch rows with any non-finite value in any output."""
+    bad = None
+    for v in out.values():
+        a = np.asarray(v)
+        if not np.issubdtype(a.dtype, np.number):
+            continue
+        row_ok = np.isfinite(a).reshape(a.shape[0], -1).all(axis=1)
+        bad = ~row_ok if bad is None else (bad | ~row_ok)
+    if bad is None:
+        return np.array([], dtype=int)
+    return np.nonzero(bad)[0]
+
+
+# ------------------------------------------------------- retry / degradation
+
+
+def _is_oom(e):
+    s = str(e)
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s)
+
+
+def _is_transient(e):
+    if isinstance(e, faults.TransientInjectedError):
+        return True
+    s = str(e)
+    return any(tok in s for tok in (
+        "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+        "Socket closed", "Connection reset", "failed to connect"))
+
+
+def eval_with_recovery(compute, chunk, shard, max_retries=3, backoff_s=0.5,
+                       _depth=0):
+    """Evaluate one shard chunk with retry/backoff and OOM halving.
+
+    compute : callable(chunk_dict) -> dict of per-row numpy arrays (same
+        leading length as the chunk).
+    Transient errors (dead-tunnel RPC strings, injected faults) retry up
+    to ``max_retries`` times with exponential backoff; a device OOM
+    halves the batch and evaluates the two halves independently
+    (recursively, down to single rows).  Anything else propagates."""
+    n = len(next(iter(chunk.values())))
+    attempt = 0
+    while True:
+        try:
+            faults.check("shard_eval")
+            return compute(chunk)
+        except Exception as e:
+            if _is_oom(e) and n > 1:
+                half = n // 2
+                log_event("shard_oom_split", shard=shard, rows=n,
+                          split=[half, n - half], error=str(e)[:200])
+                lo = eval_with_recovery(
+                    compute, {k: v[:half] for k, v in chunk.items()},
+                    shard, max_retries, backoff_s, _depth + 1)
+                hi = eval_with_recovery(
+                    compute, {k: v[half:] for k, v in chunk.items()},
+                    shard, max_retries, backoff_s, _depth + 1)
+                return {k: np.concatenate([lo[k], hi[k]]) for k in lo}
+            if _is_transient(e) and attempt < max_retries:
+                attempt += 1
+                delay = backoff_s * (2.0 ** (attempt - 1))
+                log_event("shard_retry", shard=shard, attempt=attempt,
+                          max_retries=max_retries, delay_s=round(delay, 3),
+                          error=str(e)[:200])
+                time.sleep(delay)
+                continue
+            raise
+
+
+_PROBE_VERDICT = None  # per-process cache: backend health doesn't flap
+
+
+def resolve_mesh(make_mesh, mesh=None):
+    """Resolve the sweep mesh, degrading to the CPU backend when the
+    accelerator is unhealthy.
+
+    When no mesh is given and the platform is not explicitly cpu, the
+    backend is health-probed in a subprocess first
+    (:func:`raft_tpu.utils.devices.probe_backend`) — a dead tunnel hangs
+    in-process jax init, which would otherwise take the whole sweep down
+    with it.  On probe failure the process is pinned to the CPU platform
+    and a ``backend_fallback`` event is logged; the pin only takes
+    effect before the first in-process backend init, so call this before
+    any jax computation (``backend_fallback_failed`` is logged when the
+    pin could not be applied).  The probe verdict is cached per process
+    (one subprocess, not one per sweep)."""
+    global _PROBE_VERDICT
+    if mesh is not None:
+        return mesh
+    from raft_tpu.utils.devices import probe_backend
+
+    # an installed accelerator plugin (axon) selects its platform with
+    # JAX_PLATFORMS *unset*, so an empty env var means "unknown, possibly
+    # accelerator" — probe unless the platform is explicitly cpu
+    platform = (os.environ.get("JAX_PLATFORMS", "") or "").split(",")[0]
+    forced = faults.take("unhealthy", "backend_probe")
+    unhealthy = forced
+    if not forced and platform != "cpu":
+        if _PROBE_VERDICT is None:
+            _PROBE_VERDICT = probe_backend()
+        unhealthy = not _PROBE_VERDICT
+    if unhealthy:
+        import jax
+
+        pinned = False
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            pinned = jax.default_backend() == "cpu"
+        except Exception:
+            pinned = False
+        if pinned:
+            log_event("backend_fallback", from_platform=platform or "default",
+                      to_platform="cpu", forced_by_fault=forced)
+        else:
+            # a backend was already initialized in-process; the sweep
+            # will run (or fail) on it — don't log a fallback that
+            # didn't happen
+            log_event("backend_fallback_failed",
+                      from_platform=platform or "default",
+                      reason="jax backend already initialized; cpu pin "
+                             "had no effect")
+    return make_mesh()
+
+
+# ------------------------------------------------------------- sweep runner
+
+
+def run_checkpointed(compute, cases, out_dir, shard_size, mesh, out_keys,
+                     on_shard=None, max_retries=3, backoff_s=0.5,
+                     quarantine_retry=True):
+    """Shared fault-tolerant core of the checkpointed sweep drivers.
+
+    compute : callable(chunk_dict, mesh) -> dict of stacked outputs
+        (jax or numpy arrays, leading axis == chunk length; the callable
+        must pad to the mesh itself if needed — the core always passes
+        chunks whose length it reports truthfully and trims nothing).
+    cases : dict of equal-length (N, ...) numpy arrays.
+
+    Orchestration per shard: resume-validate -> (recompute on
+    corruption) -> retry/backoff/OOM-halving eval -> NaN quarantine ->
+    atomic write -> manifest update.  Returns the dict of concatenated
+    results; quarantined row indices/params are in
+    ``<out_dir>/quarantine.json`` and the rows themselves are left
+    non-finite (aggregate nan-aware, or drop via the quarantine list)."""
+    os.makedirs(out_dir, exist_ok=True)
+    cases = {k: np.asarray(v) for k, v in cases.items()}
+    lengths = {k: len(v) for k, v in cases.items()}
+    if len(set(lengths.values())) > 1:
+        raise ValueError(
+            f"ragged case dict: all case arrays must have equal length, "
+            f"got {lengths}")
+    n = next(iter(lengths.values()))
+    n_shards = (n + shard_size - 1) // shard_size
+
+    fingerprint = compute_fingerprint(cases, out_keys, shard_size, mesh)
+    manifest = init_manifest(out_dir, fingerprint, n_shards)
+    log_event("sweep_start", out_dir=out_dir, n_cases=n, n_shards=n_shards,
+              shard_size=shard_size, out_keys=list(out_keys),
+              mesh_shape=fingerprint["mesh_shape"])
+
+    t0 = time.perf_counter()
+    results = []
+    n_quarantined = 0
+    for s in range(n_shards):
+        path = os.path.join(out_dir, f"shard_{s:04d}.npz")
+        sl = slice(s * shard_size, min((s + 1) * shard_size, n))
+        rows = sl.stop - sl.start
+        if os.path.exists(path):
+            try:
+                out = load_shard(path, out_keys, expect_rows=rows)
+                results.append(out)
+                log_event("shard_resume", shard=s, rows=rows)
+                if on_shard is not None:
+                    on_shard(s + 1, n_shards, False)
+                continue
+            except ShardCorruptError as e:
+                # re-queue: a truncated/stale shard is recomputed, not fatal
+                log_event("shard_corrupt", shard=s, error=str(e)[:300])
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        log_event("shard_start", shard=s, rows=rows)
+        mark_shard(manifest, out_dir, s, "running")
+        t_sh = time.perf_counter()
+        chunk = {k: v[sl] for k, v in cases.items()}
+        out = eval_with_recovery(
+            lambda c: {k: np.asarray(v)[: len(next(iter(c.values())))]
+                       for k, v in compute(c, mesh).items()},
+            chunk, s, max_retries=max_retries, backoff_s=backoff_s)
+        if faults.take("nan", "shard_result"):
+            for k, v in out.items():
+                a = np.array(v)
+                if np.issubdtype(a.dtype, np.inexact):
+                    a[0] = np.nan
+                    out[k] = a
+        bad = nonfinite_rows(out)
+        entries = []
+        if bad.size:
+            out, entries = _quarantine_shard(
+                compute, chunk, out, bad, s, sl.start, mesh,
+                retry_solo=quarantine_retry)
+        # re-judge even when clean: a recomputed shard must clear its own
+        # stale quarantine entries from a previous run (no file is
+        # created for sweeps that never quarantined anything)
+        if entries or os.path.exists(_quarantine_path(out_dir)):
+            record_quarantine(out_dir, s, entries)
+        shard_quarantined = len(entries)  # rows still bad post-recovery
+        n_quarantined += shard_quarantined
+        atomic_savez(path, **out)
+        mark_shard(manifest, out_dir, s, "done",
+                   wall_s=round(time.perf_counter() - t_sh, 3),
+                   quarantined=shard_quarantined)
+        log_event("shard_done", shard=s, rows=rows,
+                  wall_s=round(time.perf_counter() - t_sh, 3))
+        results.append(out)
+        if on_shard is not None:
+            on_shard(s + 1, n_shards, True)
+
+    log_event("sweep_done", out_dir=out_dir, n_cases=n,
+              n_quarantined=n_quarantined,
+              wall_s=round(time.perf_counter() - t0, 3))
+    return {k: np.concatenate([r[k] for r in results]) for k in out_keys}
+
+
+def _quarantine_shard(compute, chunk, out, bad, shard, offset, mesh,
+                      retry_solo=True):
+    """Handle non-finite rows in one computed shard.
+
+    Optionally re-evaluates each offending row solo on the CPU backend
+    (a TPU-side numerical pathology — e.g. f32 overflow in the drag
+    linearization — can converge fine in host f64); rows that stay
+    non-finite are recorded with their case parameters and left NaN in
+    the shard so downstream aggregation can never mistake them for
+    physics."""
+    out = {k: np.array(v) for k, v in out.items()}
+    entries = []
+    cpu_mesh = _cpu_mesh(mesh) if retry_solo else None
+    for i in (int(b) for b in bad):
+        keys_bad = [k for k, v in out.items()
+                    if np.issubdtype(np.asarray(v).dtype, np.number)
+                    and not np.isfinite(np.asarray(v[i])).all()]
+        recovered = False
+        if cpu_mesh is not None:
+            solo = {k: v[i:i + 1] for k, v in chunk.items()}
+            try:
+                retried = {k: np.asarray(v)[:1]
+                           for k, v in compute(solo, cpu_mesh).items()}
+                if not nonfinite_rows(retried).size:
+                    for k in out:
+                        out[k][i] = retried[k][0]
+                    recovered = True
+            except Exception as e:
+                log_event("shard_quarantine_retry_failed", shard=shard,
+                          index=offset + i, error=str(e)[:200])
+        log_event("shard_quarantine", shard=shard, index=offset + i,
+                  keys=keys_bad, recovered=recovered)
+        if not recovered:
+            entries.append({
+                "shard": shard,
+                "index": offset + i,
+                "keys_nonfinite": keys_bad,
+                "case": {k: np.asarray(v[i]).tolist()
+                         for k, v in chunk.items()},
+            })
+    return out, entries
+
+
+def _cpu_mesh(mesh):
+    """A single-CPU-device mesh with the same axis names as ``mesh``
+    (for solo quarantine retries); None when no CPU backend exists."""
+    import jax
+    from jax.sharding import Mesh
+
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        return None
+    devs = np.array([cpu]).reshape((1,) * len(mesh.axis_names))
+    return Mesh(devs, mesh.axis_names)

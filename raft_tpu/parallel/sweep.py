@@ -38,6 +38,17 @@ def make_mesh(n_devices=None, axis_names=("dp",)):
     return Mesh(devices.reshape(shape), axis_names)
 
 
+def _check_dp_divisible(n, mesh):
+    """Reject batch sizes GSPMD cannot lay out, with a readable error
+    (the raw failure is a cryptic sharding/padding XlaRuntimeError)."""
+    dp = mesh.shape.get("dp", 1)
+    if n % dp:
+        raise ValueError(
+            f"batch size {n} is not divisible by the dp mesh-axis size "
+            f"{dp} (mesh {dict(mesh.shape)}); pad the batch or use the "
+            "checkpointed drivers, which pad shard tails automatically")
+
+
 def sweep_cases(evaluate, Hs, Tp, beta, mesh=None, out_keys=("PSD", "X0")):
     """Evaluate a batch of sea states, sharded over the mesh's dp axis.
 
@@ -46,6 +57,7 @@ def sweep_cases(evaluate, Hs, Tp, beta, mesh=None, out_keys=("PSD", "X0")):
     """
     if mesh is None:
         mesh = make_mesh()
+    _check_dp_divisible(len(np.asarray(Hs)), mesh)
     batched = jax.vmap(lambda h, t, b: {k: evaluate(h, t, b)[k] for k in out_keys})
     sharding = NamedSharding(mesh, P("dp"))
     fn = jax.jit(batched, in_shardings=(sharding, sharding, sharding))
@@ -74,6 +86,12 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
     """
     if mesh is None:
         mesh = make_mesh()
+    lengths = {k: len(np.asarray(v)) for k, v in cases.items()}
+    if len(set(lengths.values())) > 1:
+        raise ValueError(
+            f"ragged case dict: all case arrays must have equal length, "
+            f"got {lengths}")
+    _check_dp_divisible(next(iter(lengths.values())), mesh)
     batched = jax.vmap(lambda c: {k: evaluate(c)[k] for k in out_keys})
     in_sh = jax.tree_util.tree_map(
         lambda _: NamedSharding(mesh, P("dp")), cases)
@@ -94,7 +112,9 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
 
 def run_sweep_checkpointed_full(evaluate, cases, out_dir, shard_size=256,
                                 mesh=None, out_keys=("PSD", "X0"),
-                                shard_freq=False, on_shard=None):
+                                shard_freq=False, on_shard=None,
+                                max_retries=3, backoff_s=0.5,
+                                quarantine_retry=True):
     """Checkpointed full-physics sweep over a case/design dict.
 
     Generalizes :func:`run_sweep_checkpointed` to the full evaluator's
@@ -106,40 +126,37 @@ def run_sweep_checkpointed_full(evaluate, cases, out_dir, shard_size=256,
     each shard (``fresh`` False when the shard was resumed from disk) —
     lets long sweeps persist incremental summaries so a preempted run
     still leaves an auditable artifact.
+
+    Fault tolerance (see :mod:`raft_tpu.parallel.resilience` and the
+    README "Fault tolerance" section): shard files are written
+    atomically and validated on resume (a truncated/corrupt/stale shard
+    is recomputed, not crashed on); ``manifest.json`` fingerprints the
+    inputs so resuming with changed cases/out_keys/shard_size raises
+    :class:`~raft_tpu.parallel.resilience.ManifestMismatchError`;
+    transient evaluator errors retry with exponential backoff
+    (``max_retries``/``backoff_s``), device OOM halves the shard batch;
+    non-finite rows are quarantined to ``quarantine.json`` (after an
+    optional solo CPU re-evaluation, ``quarantine_retry``) instead of
+    silently poisoning downstream aggregates.
     """
-    import os
+    from raft_tpu.parallel import resilience
 
-    os.makedirs(out_dir, exist_ok=True)
-    cases = {k: np.asarray(v) for k, v in cases.items()}
-    n = len(next(iter(cases.values())))
-    n_shards = (n + shard_size - 1) // shard_size
     if mesh is None:
-        mesh = make_mesh()
-    ndev = mesh.devices.size
+        mesh = resilience.resolve_mesh(make_mesh)
 
-    results = []
-    for s in range(n_shards):
-        path = os.path.join(out_dir, f"shard_{s:04d}.npz")
-        if os.path.exists(path):
-            results.append(dict(np.load(path)))
-            if on_shard is not None:
-                on_shard(s + 1, n_shards, False)
-            continue
-        sl = slice(s * shard_size, min((s + 1) * shard_size, n))
-        chunk = {k: v[sl] for k, v in cases.items()}
-        pad = (-(sl.stop - sl.start)) % ndev
+    def compute(chunk, mesh_):
+        ndev = mesh_.devices.size
+        pad = (-len(next(iter(chunk.values())))) % ndev
         if pad:
             chunk = {k: np.concatenate([v, np.repeat(v[-1:], pad, 0)])
                      for k, v in chunk.items()}
-        out = sweep_cases_full(evaluate, chunk, mesh=mesh, out_keys=out_keys,
-                               shard_freq=shard_freq)
-        out = {k: np.asarray(v)[: sl.stop - sl.start] for k, v in out.items()}
-        np.savez(path, **out)
-        results.append(out)
-        if on_shard is not None:
-            on_shard(s + 1, n_shards, True)
+        return sweep_cases_full(evaluate, chunk, mesh=mesh_,
+                                out_keys=out_keys, shard_freq=shard_freq)
 
-    return {k: np.concatenate([r[k] for r in results]) for k in out_keys}
+    return resilience.run_checkpointed(
+        compute, cases, out_dir, shard_size, mesh, out_keys,
+        on_shard=on_shard, max_retries=max_retries, backoff_s=backoff_s,
+        quarantine_retry=quarantine_retry)
 
 
 def qtf_slender_sharded(model, waveHeadInd=0, Xi0=None, ifowt=0, mesh=None):
@@ -224,7 +241,9 @@ def qtf_slender_sharded(model, waveHeadInd=0, Xi0=None, ifowt=0, mesh=None):
 
 
 def run_sweep_checkpointed(evaluate, Hs, Tp, beta, out_dir, shard_size=256,
-                           mesh=None, out_keys=("PSD", "X0")):
+                           mesh=None, out_keys=("PSD", "X0"),
+                           on_shard=None, max_retries=3, backoff_s=0.5,
+                           quarantine_retry=True):
     """Large design/case sweep with per-shard checkpointing and resume.
 
     The reference has no checkpoint/resume story for sweeps (SURVEY.md
@@ -232,35 +251,30 @@ def run_sweep_checkpointed(evaluate, Hs, Tp, beta, out_dir, shard_size=256,
     program and written to ``<out_dir>/shard_NNNN.npz`` — re-running
     skips completed shards, so a pre-empted pod job resumes where it
     stopped.  Returns the dict of concatenated results.
+
+    Shares the fault-tolerant runtime of
+    :func:`run_sweep_checkpointed_full` (atomic writes, manifest
+    validation, retry/backoff, OOM halving, NaN quarantine) via
+    :mod:`raft_tpu.parallel.resilience`.
     """
-    import os
+    from raft_tpu.parallel import resilience
 
-    os.makedirs(out_dir, exist_ok=True)
-    Hs = np.asarray(Hs)
-    Tp = np.asarray(Tp)
-    beta = np.asarray(beta)
-    n = len(Hs)
-    n_shards = (n + shard_size - 1) // shard_size
     if mesh is None:
-        mesh = make_mesh()
-    ndev = mesh.devices.size
+        mesh = resilience.resolve_mesh(make_mesh)
+    cases = {"Hs": np.asarray(Hs), "Tp": np.asarray(Tp),
+             "beta": np.asarray(beta)}
 
-    results = []
-    for s in range(n_shards):
-        path = os.path.join(out_dir, f"shard_{s:04d}.npz")
-        if os.path.exists(path):
-            results.append(dict(np.load(path)))
-            continue
-        sl = slice(s * shard_size, min((s + 1) * shard_size, n))
-        h, t, b = Hs[sl], Tp[sl], beta[sl]
+    def compute(chunk, mesh_):
+        ndev = mesh_.devices.size
+        h, t, b = chunk["Hs"], chunk["Tp"], chunk["beta"]
         pad = (-len(h)) % ndev  # pad the tail shard to the device count
         if pad:
             h = np.concatenate([h, np.full(pad, h[-1])])
             t = np.concatenate([t, np.full(pad, t[-1])])
             b = np.concatenate([b, np.full(pad, b[-1])])
-        out = sweep_cases(evaluate, h, t, b, mesh=mesh, out_keys=out_keys)
-        out = {k2: np.asarray(v)[: sl.stop - sl.start] for k2, v in out.items()}
-        np.savez(path, **out)
-        results.append(out)
+        return sweep_cases(evaluate, h, t, b, mesh=mesh_, out_keys=out_keys)
 
-    return {k2: np.concatenate([r[k2] for r in results]) for k2 in out_keys}
+    return resilience.run_checkpointed(
+        compute, cases, out_dir, shard_size, mesh, out_keys,
+        on_shard=on_shard, max_retries=max_retries, backoff_s=backoff_s,
+        quarantine_retry=quarantine_retry)

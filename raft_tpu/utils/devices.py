@@ -12,6 +12,7 @@ caller places them.
 from __future__ import annotations
 
 import contextlib
+import os
 
 import jax
 
@@ -34,3 +35,44 @@ def to_host(tree):
     return jax.tree_util.tree_map(
         lambda x: np.asarray(x) if hasattr(x, "dtype") else x, tree
     )
+
+
+def probe_backend(platform=None, timeout_s=None):
+    """Health-probe an accelerator backend without risking this process.
+
+    A dead accelerator tunnel (observed with the axon TPU plugin) hangs
+    jax backend *initialization* until killed, so the probe runs one tiny
+    matmul in a **subprocess** with a timeout: the parent never touches
+    the suspect backend.  Promoted from the ad-hoc probe in ``bench.py``
+    so sweeps and benches share one health check.
+
+    platform : optional JAX platform name to pin in the child (e.g.
+        ``"tpu"``); default lets the child use its ambient default.
+    timeout_s : seconds before the backend is declared dead (default
+        from ``RAFT_TPU_PROBE_S``, else 300 — first contact with a cold
+        TPU tunnel is legitimately slow).
+
+    Returns True when the backend answered, False on timeout/error.
+    """
+    import subprocess
+    import sys
+
+    from raft_tpu.utils import faults
+
+    if faults.take("unhealthy", "backend_probe"):
+        return False
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("RAFT_TPU_PROBE_S", "300"))
+    env = dict(os.environ)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "x = jnp.ones((128, 128)); (x @ x).block_until_ready(); "
+             "print('ok', jax.devices()[0].device_kind)"],
+            timeout=timeout_s, capture_output=True, text=True, env=env)
+        return p.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False

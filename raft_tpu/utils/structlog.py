@@ -68,7 +68,10 @@ def log_event(event, **payload):
             except Exception:
                 v = str(v)
         rec[k] = v
-    s.write(json.dumps(rec) + "\n")
+    # default=str: a non-JSON-serializable payload value (Path, dtype,
+    # exception, device object) must never take down the analysis that
+    # was merely trying to log it
+    s.write(json.dumps(rec, default=str) + "\n")
     s.flush()
 
 

@@ -47,7 +47,14 @@ def main():
     import jax.numpy as jnp
 
     import bench
+    from raft_tpu.parallel import resilience
     from raft_tpu.parallel.sweep import make_mesh, run_sweep_checkpointed_full
+
+    # resolve the mesh BEFORE the first jax computation: the health
+    # probe runs in a subprocess, and on a dead accelerator tunnel the
+    # CPU-platform pin only takes effect if no in-process backend has
+    # been initialized yet (bench.build() below is the first jnp touch)
+    mesh = (None if args.platform else resilience.resolve_mesh(make_mesh))
 
     model, evaluate = bench.build()       # geometry=True full evaluator
     dw = model.w[1] - model.w[0]
@@ -82,7 +89,8 @@ def main():
         )
 
     g4 = bench.sample_geometry(args.n, seed=11).astype(np.float32)
-    mesh = make_mesh()
+    if mesh is None:
+        mesh = make_mesh()
     print(f"devices: {mesh.devices.size} x "
           f"{jax.devices()[0].device_kind}; {args.n} designs "
           f"(100w x {len(bench.CASES)} cases each)", flush=True)
@@ -122,8 +130,13 @@ def main():
     # loads shards from disk in seconds and must not overwrite the
     # artifact with a bogus thousands-of-evals/s headline
     fresh_designs = min(n_fresh[0] * args.shard, n_done)
+    # quarantined designs (non-finite rows, see quarantine.json) are
+    # excluded from the aggregates via nan-aware reductions — one
+    # non-converged drag linearization must not poison the ranges
+    quarantined = resilience.load_quarantine(args.out)
     summary = dict(
         n_designs=int(n_done),
+        n_quarantined=len(quarantined),
         cases_per_design=len(bench.CASES),
         n_freq=int(model.nw),
         wall_s=round(wall, 2),
@@ -135,11 +148,11 @@ def main():
         n_devices=int(mesh.devices.size),
         shard_size=args.shard,
         out_dir=args.out,
-        max_offset_range=[float(np.min(out["max_offset"])),
-                          float(np.max(out["max_offset"]))],
-        max_pitch_range=[float(np.min(out["max_pitch_deg"])),
-                         float(np.max(out["max_pitch_deg"]))],
-        worst_drag_resid=float(np.max(out["drag_resid"])),
+        max_offset_range=[float(np.nanmin(out["max_offset"])),
+                          float(np.nanmax(out["max_offset"]))],
+        max_pitch_range=[float(np.nanmin(out["max_pitch_deg"])),
+                         float(np.nanmax(out["max_pitch_deg"]))],
+        worst_drag_resid=float(np.nanmax(out["drag_resid"])),
     )
     with open("SWEEP_10K.json", "w") as f:
         json.dump(summary, f, indent=1)

@@ -82,8 +82,9 @@ def test_checkpointed_sweep_and_resume(spar_eval, tmp_path):
     out1 = run_sweep_checkpointed(evaluate, Hs, Tp, beta, out_dir,
                                   shard_size=8, mesh=mesh, out_keys=("PSD", "X0"))
     assert out1["PSD"].shape[0] == n
-    shards = sorted(os.listdir(out_dir))
+    shards = sorted(f for f in os.listdir(out_dir) if f.endswith(".npz"))
     assert shards == ["shard_0000.npz", "shard_0001.npz", "shard_0002.npz"]
+    assert os.path.exists(os.path.join(out_dir, "manifest.json"))
 
     # parity with the plain sharded sweep
     ref = sweep_cases(evaluate, Hs[:8], Tp[:8], beta[:8], mesh=mesh,

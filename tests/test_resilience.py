@@ -1,0 +1,428 @@
+"""Fault-tolerance tests for the checkpointed sweep runtime.
+
+Every failure path in :mod:`raft_tpu.parallel.resilience` is exercised
+deterministically via :mod:`raft_tpu.utils.faults` with cheap toy
+evaluators on a small CPU mesh (fast tier — no model build, no physics):
+
+* resume after an injected mid-write truncation is bit-identical to an
+  uninterrupted run, with the corrupt shard recomputed;
+* manifest fingerprint mismatches (changed inputs / out_keys /
+  shard_size) fail loudly instead of mixing stale shards;
+* transient faults retry with backoff and then succeed;
+* injected device-OOM halves the shard batch and still completes;
+* NaN rows are quarantined with their case parameters (and recovered by
+  the solo CPU re-evaluation when the pathology is transient);
+* every recovery action is visible in the structured JSONL event log.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.parallel import resilience
+from raft_tpu.parallel.sweep import (
+    make_mesh, run_sweep_checkpointed, run_sweep_checkpointed_full,
+    sweep_cases, sweep_cases_full)
+from raft_tpu.utils import faults
+from raft_tpu.utils.structlog import log_event
+
+
+def toy_full(c):
+    """Cheap full-evaluator stand-in: dict case -> dict of outputs."""
+    return {"PSD": jnp.stack([c["Hs"], c["Tp"], c["Hs"] * c["Tp"]]),
+            "X0": c["Hs"] - c["Tp"]}
+
+
+def toy_nan_full(c):
+    """Toy evaluator with a deterministic pathology: NaN for Hs < 0."""
+    bad = c["Hs"] < 0
+    return {"PSD": jnp.where(bad, jnp.nan,
+                             jnp.stack([c["Hs"], c["Tp"], c["Hs"] * c["Tp"]])),
+            "X0": jnp.where(bad, jnp.nan, c["Hs"] - c["Tp"])}
+
+
+def toy_case(h, t, b):
+    return {"PSD": jnp.stack([h, t, b]), "X0": h + t + b}
+
+
+def _cases(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return dict(Hs=2.0 + 6.0 * rng.random(n), Tp=8.0 + 8.0 * rng.random(n))
+
+
+def _events(path, name=None):
+    with open(path) as f:
+        evs = [json.loads(line) for line in f if line.strip()]
+    return [e for e in evs if name is None or e["event"] == name]
+
+
+@pytest.fixture
+def log_path(tmp_path, monkeypatch):
+    p = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", p)
+    return p
+
+
+MESH = None
+
+
+def mesh2():
+    global MESH
+    if MESH is None:
+        MESH = make_mesh(2)
+    return MESH
+
+
+# ------------------------------------------------------------ atomic writes
+
+
+def test_checkpoint_roundtrip_manifest_and_no_tmp_left(tmp_path, log_path):
+    cases = _cases(10)
+    out_dir = str(tmp_path / "sweep")
+    out1 = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                       shard_size=4, mesh=mesh2())
+    assert out1["PSD"].shape == (10, 3)
+    np.testing.assert_allclose(out1["X0"], cases["Hs"] - cases["Tp"])
+
+    files = sorted(os.listdir(out_dir))
+    assert not [f for f in files if f.endswith(".tmp")]
+    with open(os.path.join(out_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    fp = manifest["fingerprint"]
+    assert fp["n_cases"] == 10 and fp["shard_size"] == 4
+    assert fp["out_keys"] == ["PSD", "X0"]
+    assert set(fp["case_hashes"]) == {"Hs", "Tp"}
+    assert all(manifest["shards"][str(s)]["status"] == "done"
+               for s in range(3))
+
+    # resume: all three shards load from disk, bit-identical
+    out2 = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                       shard_size=4, mesh=mesh2())
+    for k in out1:
+        assert np.array_equal(out1[k], out2[k])
+    assert len(_events(log_path, "shard_resume")) == 3
+
+
+def test_truncation_crash_then_resume_bit_identical(tmp_path, log_path):
+    """The acceptance scenario: a sweep killed mid-shard-write resumes
+    to bit-identical results, recomputing only the corrupt shard."""
+    cases = _cases(10, seed=1)
+    clean = run_sweep_checkpointed_full(toy_full, cases,
+                                        str(tmp_path / "clean"),
+                                        shard_size=4, mesh=mesh2())
+
+    out_dir = str(tmp_path / "crashy")
+    with faults.inject("truncate:shard_write:1"):
+        with pytest.raises(faults.InjectedFault):
+            run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                        shard_size=4, mesh=mesh2())
+    # the injected fault left a TRUNCATED shard file at the final path
+    p0 = os.path.join(out_dir, "shard_0000.npz")
+    assert os.path.exists(p0)
+    with pytest.raises(resilience.ShardCorruptError):
+        resilience.load_shard(p0, ("PSD", "X0"))
+
+    resumed = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                          shard_size=4, mesh=mesh2())
+    for k in clean:
+        assert np.array_equal(clean[k], resumed[k]), k
+    corrupt = _events(log_path, "shard_corrupt")
+    assert [e["shard"] for e in corrupt] == [0]
+
+
+def test_corrupt_middle_shard_requeued_not_crashed(tmp_path, log_path):
+    cases = _cases(12, seed=2)
+    out_dir = str(tmp_path / "sweep")
+    out1 = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                       shard_size=4, mesh=mesh2())
+    faults.truncate_file(os.path.join(out_dir, "shard_0001.npz"))
+    out2 = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                       shard_size=4, mesh=mesh2())
+    for k in out1:
+        assert np.array_equal(out1[k], out2[k])
+    assert [e["shard"] for e in _events(log_path, "shard_corrupt")] == [1]
+    # shards 0 and 2 were NOT recomputed
+    assert sorted(e["shard"] for e in _events(log_path, "shard_resume")) \
+        == [0, 2]
+
+
+def test_stale_shard_with_missing_keys_recomputed(tmp_path, log_path):
+    cases = _cases(8, seed=3)
+    out_dir = str(tmp_path / "sweep")
+    out1 = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                       shard_size=4, mesh=mesh2())
+    # overwrite a shard with one missing output key (stale layout)
+    p1 = os.path.join(out_dir, "shard_0001.npz")
+    with np.load(p1) as z:
+        np.savez(p1, PSD=z["PSD"])
+    out2 = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                       shard_size=4, mesh=mesh2())
+    for k in out1:
+        assert np.array_equal(out1[k], out2[k])
+    assert [e["shard"] for e in _events(log_path, "shard_corrupt")] == [1]
+
+
+# -------------------------------------------------------- manifest validation
+
+
+def test_manifest_mismatch_fails_loudly(tmp_path):
+    cases = _cases(8, seed=4)
+    out_dir = str(tmp_path / "sweep")
+    run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                shard_size=4, mesh=mesh2())
+
+    changed = dict(cases, Hs=cases["Hs"] + 0.1)
+    with pytest.raises(resilience.ManifestMismatchError, match="case_hashes"):
+        run_sweep_checkpointed_full(toy_full, changed, out_dir,
+                                    shard_size=4, mesh=mesh2())
+    with pytest.raises(resilience.ManifestMismatchError, match="out_keys"):
+        run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                    shard_size=4, mesh=mesh2(),
+                                    out_keys=("PSD",))
+    with pytest.raises(resilience.ManifestMismatchError, match="shard_size"):
+        run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                    shard_size=8, mesh=mesh2())
+    # unchanged config still resumes fine
+    out = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                      shard_size=4, mesh=mesh2())
+    assert out["PSD"].shape == (8, 3)
+
+
+def test_unreadable_manifest_rejected(tmp_path):
+    cases = _cases(4, seed=5)
+    out_dir = str(tmp_path / "sweep")
+    run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                shard_size=4, mesh=mesh2())
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        f.write("{ not json")
+    with pytest.raises(resilience.ManifestMismatchError, match="unreadable"):
+        run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                    shard_size=4, mesh=mesh2())
+
+
+# ------------------------------------------------------------ retry/backoff
+
+
+def test_transient_faults_retry_then_succeed(tmp_path, log_path):
+    cases = _cases(8, seed=6)
+    clean = run_sweep_checkpointed_full(toy_full, cases,
+                                        str(tmp_path / "clean"),
+                                        shard_size=4, mesh=mesh2())
+    with faults.inject("transient:shard_eval:2"):
+        out = run_sweep_checkpointed_full(toy_full, cases,
+                                          str(tmp_path / "faulty"),
+                                          shard_size=4, mesh=mesh2(),
+                                          backoff_s=0.01)
+    for k in clean:
+        assert np.array_equal(clean[k], out[k])
+    retries = _events(log_path, "shard_retry")
+    assert [e["attempt"] for e in retries] == [1, 2]
+    # exponential backoff: second delay doubles the first
+    assert retries[1]["delay_s"] == pytest.approx(2 * retries[0]["delay_s"])
+
+
+def test_transient_faults_exhaust_retries(tmp_path):
+    cases = _cases(4, seed=7)
+    with faults.inject("transient:shard_eval:5"):
+        with pytest.raises(faults.TransientInjectedError):
+            run_sweep_checkpointed_full(toy_full, cases,
+                                        str(tmp_path / "sweep"),
+                                        shard_size=4, mesh=mesh2(),
+                                        max_retries=2, backoff_s=0.01)
+
+
+def test_oom_halves_shard_batch(tmp_path, log_path):
+    cases = _cases(8, seed=8)
+    clean = run_sweep_checkpointed_full(toy_full, cases,
+                                        str(tmp_path / "clean"),
+                                        shard_size=8, mesh=mesh2())
+    with faults.inject("oom:shard_eval:1"):
+        out = run_sweep_checkpointed_full(toy_full, cases,
+                                          str(tmp_path / "oom"),
+                                          shard_size=8, mesh=mesh2())
+    for k in clean:
+        assert np.array_equal(clean[k], out[k])
+    splits = _events(log_path, "shard_oom_split")
+    assert splits and splits[0]["rows"] == 8 and splits[0]["split"] == [4, 4]
+
+
+# ------------------------------------------------------------- quarantine
+
+
+def test_nan_quarantine_end_to_end(tmp_path, log_path):
+    cases = _cases(8, seed=9)
+    cases["Hs"][5] = -1.0  # deterministic pathology: toy_nan_full -> NaN
+    out_dir = str(tmp_path / "sweep")
+    out = run_sweep_checkpointed_full(toy_nan_full, cases, out_dir,
+                                      shard_size=4, mesh=mesh2())
+    # the poisoned row is NaN, every other row is clean
+    assert np.isnan(out["X0"][5]) and np.isnan(out["PSD"][5]).all()
+    mask = np.ones(8, bool)
+    mask[5] = False
+    assert np.isfinite(out["X0"][mask]).all()
+    np.testing.assert_allclose(out["X0"][mask],
+                               (cases["Hs"] - cases["Tp"])[mask])
+
+    entries = resilience.load_quarantine(out_dir)
+    assert len(entries) == 1
+    e = entries[0]
+    assert e["shard"] == 1 and e["index"] == 5
+    assert e["case"]["Hs"] == pytest.approx(-1.0)
+    assert set(e["keys_nonfinite"]) == {"PSD", "X0"}
+    evs = _events(log_path, "shard_quarantine")
+    assert [(v["shard"], v["index"], v["recovered"]) for v in evs] \
+        == [(1, 5, False)]
+
+    # resume: the quarantined shard is valid on disk -> no re-judging
+    out2 = run_sweep_checkpointed_full(toy_nan_full, cases, out_dir,
+                                       shard_size=4, mesh=mesh2())
+    assert np.isnan(out2["X0"][5])
+    assert len(resilience.load_quarantine(out_dir)) == 1
+
+
+def test_injected_nan_recovered_by_solo_cpu_retry(tmp_path, log_path):
+    """A transient NaN (injected once) is healed by the solo
+    re-evaluation: the row is recomputed finite, nothing is quarantined,
+    and the final results match the clean run bit-for-bit."""
+    cases = _cases(8, seed=10)
+    clean = run_sweep_checkpointed_full(toy_full, cases,
+                                        str(tmp_path / "clean"),
+                                        shard_size=4, mesh=mesh2())
+    out_dir = str(tmp_path / "nanswp")
+    with faults.inject("nan:shard_result:1"):
+        out = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                          shard_size=4, mesh=mesh2())
+    for k in clean:
+        assert np.array_equal(clean[k], out[k]), k
+    assert resilience.load_quarantine(out_dir) == []
+    evs = _events(log_path, "shard_quarantine")
+    assert [(v["shard"], v["index"], v["recovered"]) for v in evs] \
+        == [(0, 0, True)]
+
+
+def test_quarantine_without_solo_retry(tmp_path):
+    cases = _cases(4, seed=11)
+    out_dir = str(tmp_path / "sweep")
+    with faults.inject("nan:shard_result:1"):
+        out = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                          shard_size=4, mesh=mesh2(),
+                                          quarantine_retry=False)
+    assert np.isnan(out["X0"][0])
+    entries = resilience.load_quarantine(out_dir)
+    assert [e["index"] for e in entries] == [0]
+
+
+def test_recomputed_clean_shard_clears_stale_quarantine(tmp_path):
+    """A shard that quarantined rows, then got corrupted and recomputed
+    CLEAN (transient pathology), must clear its stale quarantine entries."""
+    cases = _cases(8, seed=14)
+    out_dir = str(tmp_path / "sweep")
+    with faults.inject("nan:shard_result:1"):
+        run_sweep_checkpointed_full(toy_full, cases, out_dir, shard_size=4,
+                                    mesh=mesh2(), quarantine_retry=False)
+    assert [e["index"] for e in resilience.load_quarantine(out_dir)] == [0]
+    faults.truncate_file(os.path.join(out_dir, "shard_0000.npz"))
+    out = run_sweep_checkpointed_full(toy_full, cases, out_dir, shard_size=4,
+                                      mesh=mesh2(), quarantine_retry=False)
+    assert resilience.load_quarantine(out_dir) == []
+    assert np.isfinite(out["X0"]).all()
+
+
+# --------------------------------------------- input validation satellites
+
+
+def test_batch_not_divisible_by_dp_is_clear_valueerror():
+    h = np.ones(3)
+    with pytest.raises(ValueError, match="divisible by the dp mesh-axis"):
+        sweep_cases(toy_case, h, h, h, mesh=mesh2())
+    with pytest.raises(ValueError, match="divisible by the dp mesh-axis"):
+        sweep_cases_full(toy_full, dict(Hs=h, Tp=h), mesh=mesh2())
+
+
+def test_ragged_case_dict_rejected(tmp_path):
+    ragged = dict(Hs=np.ones(8), Tp=np.ones(6))
+    with pytest.raises(ValueError, match="ragged"):
+        run_sweep_checkpointed_full(toy_full, ragged,
+                                    str(tmp_path / "sweep"),
+                                    shard_size=4, mesh=mesh2())
+    with pytest.raises(ValueError, match="ragged"):
+        sweep_cases_full(toy_full, ragged, mesh=mesh2())
+
+
+# --------------------------------------------------- legacy driver parity
+
+
+def test_legacy_checkpointed_driver_shares_runtime(tmp_path, log_path):
+    rng = np.random.default_rng(12)
+    h, t, b = rng.random(10), rng.random(10) + 8, rng.random(10)
+    out_dir = str(tmp_path / "sweep")
+    out1 = run_sweep_checkpointed(toy_case, h, t, b, out_dir,
+                                  shard_size=4, mesh=mesh2())
+    np.testing.assert_allclose(out1["X0"], h + t + b)
+    assert os.path.exists(os.path.join(out_dir, "manifest.json"))
+    faults.truncate_file(os.path.join(out_dir, "shard_0002.npz"))
+    out2 = run_sweep_checkpointed(toy_case, h, t, b, out_dir,
+                                  shard_size=4, mesh=mesh2())
+    for k in out1:
+        assert np.array_equal(out1[k], out2[k])
+    with pytest.raises(resilience.ManifestMismatchError):
+        run_sweep_checkpointed(toy_case, h + 1, t, b, out_dir,
+                               shard_size=4, mesh=mesh2())
+
+
+# ----------------------------------------------------- backend degradation
+
+
+def test_backend_fallback_event_and_sweep_completes(tmp_path, log_path):
+    cases = _cases(4, seed=13)
+    with faults.inject("unhealthy:backend_probe:1"):
+        mesh = resilience.resolve_mesh(make_mesh)
+    assert mesh.devices.size >= 1
+    evs = _events(log_path, "backend_fallback")
+    assert len(evs) == 1 and evs[0]["to_platform"] == "cpu"
+    out = run_sweep_checkpointed_full(toy_full, cases,
+                                      str(tmp_path / "sweep"),
+                                      shard_size=4, mesh=mesh)
+    assert out["PSD"].shape == (4, 3)
+
+
+# ------------------------------------------------------------- structlog
+
+
+def test_log_event_survives_non_serializable_payload(tmp_path, monkeypatch):
+    p = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", p)
+
+    class Opaque:
+        def __repr__(self):
+            return "<opaque>"
+
+    log_event("weird_payload", obj=Opaque(), arr_dtype=np.dtype("f8"),
+              exc=ValueError("boom"))
+    (rec,) = _events(p, "weird_payload")
+    assert rec["obj"] == "<opaque>"
+    assert rec["exc"] == "boom"
+
+
+# ------------------------------------------------------------ fault specs
+
+
+def test_fault_spec_parsing_and_counts():
+    with faults.inject("transient:somewhere:2"):
+        assert faults.take("transient", "somewhere")
+        assert faults.take("transient", "somewhere")
+        assert not faults.take("transient", "somewhere")  # exhausted
+    assert not faults.take("transient", "somewhere")  # disarmed on exit
+    with pytest.raises(ValueError):
+        faults.inject("justakind")
+
+
+def test_fault_env_var_arming(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_FAULTS", "nan:envsite:1")
+    assert faults.take("nan", "envsite")
+    assert not faults.take("nan", "envsite")
+    monkeypatch.setenv("RAFT_TPU_FAULTS", "")
+    assert not faults.take("nan", "envsite")
