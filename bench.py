@@ -484,22 +484,16 @@ def _catenary_np(XF, ZF, L, w_line, EA, n_iter=60):
 
 def _enable_compile_cache():
     """Persistent XLA compilation cache: repeated bench runs (driver
-    retries, round reruns) skip recompilation entirely."""
-    import jax
+    retries, round reruns) skip recompilation entirely.  The mechanism
+    lives in ``raft_tpu.utils.devices.enable_compile_cache`` (shared
+    with the drivers and sweep runtimes); the bench keeps its own
+    repo-local cache directory and the RAFT_TPU_BENCH_PLATFORM pin."""
+    from raft_tpu.utils.devices import enable_compile_cache
 
-    # the axon TPU plugin in this image overrides JAX_PLATFORMS at
-    # import time, so an explicit platform request (CPU testing) must go
-    # through the config, not the env var
-    platform = os.environ.get("RAFT_TPU_BENCH_PLATFORM")
-    if platform:
-        jax.config.update("jax_platforms", platform)
-    try:
-        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "_jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
-    except Exception:
-        pass
+    enable_compile_cache(
+        cache_dir=os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "_jax_cache"),
+        platform=os.environ.get("RAFT_TPU_BENCH_PLATFORM"))
 
 
 BASELINE_ARTIFACT = os.path.join(
@@ -726,12 +720,47 @@ def _stage_times(jit_builder, args, reps, t_compile, dt, t_start):
         return None, None
 
 
+def _drag_iters(jit_raw_builder, args, t_compile, t_dyn, t_start):
+    """Realized drag-linearisation iteration counts across the batch
+    (the fixed point reports how many masked scan trips did real work).
+    One extra pruned compilation, so only taken when the deadline
+    leaves room after the stage breakdown."""
+    import numpy as np
+
+    remaining = _deadline_remaining(t_start)
+    if t_dyn is None or (remaining is not None
+                         and remaining < 1.3 * max(t_compile, 5.0)):
+        return None
+    try:
+        it = np.asarray(jit_raw_builder("n_iter_drag")(*args))
+        return it
+    except Exception:
+        return None
+
+
 def _finish_breakdown(breakdown, t_compile, dt, t_stat, t_dyn,
-                      base_per_sec, batch_designs, distinct_geometries):
+                      base_per_sec, batch_designs, distinct_geometries,
+                      iters=None, ndof=6):
     """Shared breakdown block.  Stage prefixes are reported as RAW
     times of their own executables (differences between separately
     compiled programs can be negative and misattribute time); derived
     splits are clamped at zero."""
+    from raft_tpu.models.dynamics import fixed_point_mode
+    from raft_tpu.ops.linsolve import solver_path
+    from raft_tpu.utils.dtypes import policy_name
+
+    drag_s = (max(t_dyn - t_stat, 0.0) if t_dyn and t_stat else None)
+    it_mean = float(iters.mean()) if iters is not None else None
+    breakdown.update(
+        solver_path=solver_path(ndof),
+        fixed_point=fixed_point_mode(),
+        dtype_policy=policy_name() or "derived",
+        drag_iterations_mean=(round(it_mean, 2) if it_mean is not None
+                              else None),
+        drag_iterations_max=int(iters.max()) if iters is not None else None,
+        per_drag_iteration_s=(round(drag_s / it_mean, 5)
+                              if drag_s is not None and it_mean else None),
+    )
     breakdown.update(
         compile_s=round(t_compile, 2),
         full_pipeline_s=round(dt, 4),
@@ -809,6 +838,9 @@ def run_mode(mode):
         lambda key: jax.jit(jax.vmap(
             lambda *a: jnp.sum(jnp.abs(eval_case(*a, key=key))))),
         args, reps, t_compile, dt, t_start)
+    iters = _drag_iters(
+        lambda key: jax.jit(jax.vmap(lambda *a: eval_case(*a, key=key))),
+        args, t_compile, t_dyn, t_start)
 
     # optional profiler capture (point RAFT_TPU_PROFILE at a directory
     # and open the trace in TensorBoard / Perfetto)
@@ -820,7 +852,8 @@ def run_mode(mode):
     base_design_evals_per_sec = _numpy_baseline(model)
     breakdown = _finish_breakdown(
         _flops_breakdown(compiled, dt), t_compile, dt, t_stat, t_dyn,
-        base_design_evals_per_sec, B, True)
+        base_design_evals_per_sec, B, True, iters=iters,
+        ndof=model.fowtList[0].nDOF)
     print(json.dumps({
         "metric": "design-evals/sec/chip (VolturnUS-S geometry DoE, 100w x 12 cases, operating turbine)",
         "value": round(design_evals_per_sec, 3),
@@ -918,11 +951,14 @@ def run_flat(t_start=None):
         lambda key: jax.jit(jax.vmap(
             lambda *a: jnp.sum(jnp.abs(eval_case(*a, key=key))))),
         args, reps, t_compile, dt, t_start)
+    iters = _drag_iters(
+        lambda key: jax.jit(jax.vmap(lambda *a: eval_case(*a, key=key))),
+        args, t_compile, t_dyn, t_start)
 
     base = _numpy_baseline(model)
     breakdown = _finish_breakdown(
         _flops_breakdown(compiled, dt), t_compile, dt, t_stat, t_dyn,
-        base, B, False)
+        base, B, False, iters=iters, ndof=model.fowtList[0].nDOF)
     print(json.dumps({
         "metric": "design-evals/sec/chip (VolturnUS-S, 100w x 12 cases, operating turbine)",
         "value": round(design_evals_per_sec, 3),

@@ -27,6 +27,15 @@ from raft_tpu.physics import morison
 from raft_tpu.physics.mooring import mooring_stiffness
 from raft_tpu.physics.statics import calc_statics, node_T, platform_kinematics
 from raft_tpu.ops import waves as wv
+from raft_tpu.utils.dtypes import compute_dtypes
+
+
+def _policy_cdt():
+    """Trace-time complex dtype for the excitation-prefix
+    allocations, honouring RAFT_TPU_DTYPE (the default derives to
+    the x64-canonical complex dtype, i.e. the historical
+    behaviour)."""
+    return compute_dtypes()[1]
 
 
 def make_design_evaluator(model):
@@ -94,7 +103,7 @@ def make_design_evaluator(model):
         hc = morison.hydro_constants(fs, ss, R_ptfm, r_nodes, Tn)
 
         S = wv.jonswap(w, Hs, Tp)
-        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(_policy_cdt())
         exc = morison.hydro_excitation(
             fs, ss, hc, zeta[None, :], jnp.asarray([beta]), w, k, Tn, r_nodes)
 
@@ -119,6 +128,7 @@ def make_design_evaluator(model):
             PSD=0.5 * jnp.abs(Xi) ** 2 / dw, S=S,
             drag_resid=dyn_diag["drag_resid"],
             drag_converged=dyn_diag["drag_converged"],
+            n_iter_drag=dyn_diag["n_iter_drag"],
         )
 
     return evaluate
@@ -465,7 +475,7 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
         # ---- aero-servo constants about the rotor nodes (zero-pose Tn,
         # matching the reference's calcTurbineConstants-at-case-start)
         f_aero0 = jnp.zeros(nDOF)
-        f_aero = jnp.zeros((nDOF, nw), dtype=complex)
+        f_aero = jnp.zeros((nDOF, nw), dtype=_policy_cdt())
         A_aero = jnp.zeros((nDOF, nDOF, nw))
         B_aero = jnp.zeros((nDOF, nDOF, nw))
         B_gyro = jnp.zeros((nDOF, nDOF))
@@ -523,10 +533,10 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
 
         # ---- sea states + first-order excitation (all headings)
         S = jax.vmap(lambda h, t, g_: wv.jonswap(w, h, t, gamma=g_))(Hs, Tp, gamma)
-        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(_policy_cdt())
         exc = morison.hydro_excitation(fs, ss_t, hc, zeta, beta, w, k, Tn, r_nodes)
 
-        F_BEM = jnp.zeros((nWaves, nDOF, nw), dtype=complex)
+        F_BEM = jnp.zeros((nWaves, nDOF, nw), dtype=_policy_cdt())
         if has_X:
             X_tab = bem["X_BEM"] if X_BEM_t is None else X_BEM_t
 
@@ -541,13 +551,13 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
                 jax.vmap(bem_one)(beta_deg) * zeta[:, None, :])
 
         # ---- second-order forces (external QTF)
-        F_2nd = jnp.zeros((nWaves, nDOF, nw), dtype=complex)
+        F_2nd = jnp.zeros((nWaves, nDOF, nw), dtype=_policy_cdt())
         F_2nd_mean = jnp.zeros((nWaves, nDOF))
         if Qm is not None:
             def qtf_one(b_h, S_h):
                 return _hydro_force_2nd_traced(Qm, qtf["heads_rad"], b_h, S_h, dw)
             fm, f2 = jax.vmap(qtf_one)(beta, S)
-            F_2nd = F_2nd.at[:, :6, :].set(f2.astype(complex))
+            F_2nd = F_2nd.at[:, :6, :].set(f2.astype(_policy_cdt()))
             F_2nd_mean = F_2nd_mean.at[:, :6].set(fm)
 
         # ---- linear system (raft_model.py:1045-1048)
@@ -573,7 +583,7 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
             return F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag + F_2nd[ih]
         F_waves = jnp.stack([fwave_one(ih) for ih in range(nWaves)])
         Xi = system_response(Z, F_waves)
-        Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=complex)])
+        Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=Xi.dtype)])
 
         # ---- mean-drift fed back into the equilibrium for the reported
         # offsets (raft_model.py:316-328); Xi is not recomputed
@@ -593,6 +603,7 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
             F_2nd_mean=F_2nd_mean, Z=Z,
             drag_resid=dyn_diag["drag_resid"],
             drag_converged=dyn_diag["drag_converged"],
+            n_iter_drag=dyn_diag["n_iter_drag"],
         )
 
     evaluate.geometry_constants = geometry_constants
@@ -722,10 +733,11 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
 
         # ---- sea states (shared across units; phases via positions)
         S = jax.vmap(lambda h, t, g_: wv.jonswap(w, h, t, gamma=g_))(Hs, Tp, gamma)
-        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(_policy_cdt())
 
         # ---- per-FOWT excitation + drag-linearised impedance
-        Z_blocks, F_waves, resids = [], [[] for _ in range(nWaves)], []
+        Z_blocks, resids, iters = [], [], []
+        F_waves = [[] for _ in range(nWaves)]
         for i, fs_i in enumerate(fowts):
             nDOF = fs_i.nDOF
             X0_i = X0[offs[i]:offs[i + 1]]
@@ -756,6 +768,7 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
             n_iter_extra=model.nIterExtra)
             Z_blocks.append(Z_i)
             resids.append(diag_i["drag_resid"])
+            iters.append(diag_i["n_iter_drag"])
             for ih in range(nWaves):
                 F_drag = morison.drag_excitation(
                     fs_i, sss[i], hc, Bmat, exc["u"][ih], Tn, r_nodes)
@@ -763,7 +776,8 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
 
         # ---- system impedance: block FOWT impedances + shared-mooring
         # stiffness (raft_model.py:1164-1182)
-        Z_sys = jnp.zeros((nw, nDOF_T, nDOF_T), dtype=complex)
+        Z_sys = jnp.zeros((nw, nDOF_T, nDOF_T),
+                          dtype=Z_blocks[0].dtype)
         for i in range(nFOWT):
             Z_sys = Z_sys.at[:, offs[i]:offs[i + 1], offs[i]:offs[i + 1]].add(
                 Z_blocks[i])
@@ -780,10 +794,11 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
         F_sys = jnp.stack([jnp.concatenate(Fw, axis=0) for Fw in F_waves])
         Xi = system_response(Z_sys, F_sys)
         Xi = jnp.concatenate(
-            [Xi, jnp.zeros((1, nDOF_T, nw), dtype=complex)])
+            [Xi, jnp.zeros((1, nDOF_T, nw), dtype=Xi.dtype)])
         PSD = jnp.sum(0.5 * jnp.abs(Xi) ** 2 / dw, axis=0)
         return dict(X0=X0, Xi=Xi, PSD=PSD, S=S, zeta=zeta,
-                    drag_resid=jnp.stack(resids))
+                    drag_resid=jnp.stack(resids),
+                    n_iter_drag=jnp.stack(iters))
 
     return evaluate
 
@@ -967,7 +982,7 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None,
 
         # ---- excitation + drag-linearised N-DOF impedance solve
         S = jax.vmap(lambda h, t, g_: wv.jonswap(w, h, t, gamma=g_))(Hs, Tp, gamma)
-        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(_policy_cdt())
         exc = morison.hydro_excitation(fs, ss_t, hc, zeta, beta, w, k, Tn, r_nodes)
 
         C_moor = jnp.zeros((nDOF, nDOF))
@@ -990,11 +1005,12 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None,
             return exc["F_hydro_iner"][ih] + F_drag
         F_waves = jnp.stack([fwave_one(ih) for ih in range(nWaves)])
         Xi = system_response(Z, F_waves)
-        Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=complex)])
+        Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=Xi.dtype)])
         PSD = jnp.sum(0.5 * jnp.abs(Xi) ** 2 / dw, axis=0)
         return dict(X0=X0, Xi=Xi, PSD=PSD, S=S, zeta=zeta,
                     drag_resid=dyn_diag["drag_resid"],
-                    drag_converged=dyn_diag["drag_converged"])
+                    drag_converged=dyn_diag["drag_converged"],
+                    n_iter_drag=dyn_diag["n_iter_drag"])
 
     return evaluate
 
@@ -1039,7 +1055,7 @@ def make_case_evaluator(model, n_stat_iter=12):
 
         # --- sea state + excitation
         S = wv.jonswap(w, Hs, Tp)
-        zeta = jnp.sqrt(2.0 * S * dw).astype(complex)
+        zeta = jnp.sqrt(2.0 * S * dw).astype(_policy_cdt())
         exc = morison.hydro_excitation(
             fs, ss, hc, zeta[None, :], jnp.asarray([beta]), w, k, Tn, r_nodes
         )
@@ -1067,6 +1083,7 @@ def make_case_evaluator(model, n_stat_iter=12):
         PSD = 0.5 * jnp.abs(Xi) ** 2 / dw
         return dict(X0=X0, Xi=Xi, RAO=RAO, PSD=PSD, S=S,
                     drag_resid=dyn_diag["drag_resid"],
-                    drag_converged=dyn_diag["drag_converged"])
+                    drag_converged=dyn_diag["drag_converged"],
+                    n_iter_drag=dyn_diag["n_iter_drag"])
 
     return evaluate

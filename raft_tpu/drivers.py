@@ -16,7 +16,9 @@ def run(input_file, save_csv=None):
 
     runRAFT equivalent: YAML -> Model -> analyze_cases (-> CSV)."""
     import raft_tpu
+    from raft_tpu.utils.devices import enable_compile_cache
 
+    enable_compile_cache()
     model = raft_tpu.Model(input_file)
     model.analyze_cases()
     if save_csv:
@@ -33,7 +35,9 @@ def run_farm(input_file, save_csv=None):
     case metrics only, no single-FOWT property/eigen outputs.
     Returns the Model."""
     import raft_tpu
+    from raft_tpu.utils.devices import enable_compile_cache
 
+    enable_compile_cache()
     model = raft_tpu.Model(input_file)
     model.analyze_cases()
     if save_csv:

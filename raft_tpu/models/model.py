@@ -474,7 +474,8 @@ class Model:
                         ms_i, np.asarray(X0[offs[i]:offs[i] + 6]),
                         self.w, self.k, fh.S[0], fh.beta[0], self.depth,
                         rho=fs.rho_water, g=fs.g)
-                    Z_moor = jnp.zeros((nw, nDOF, nDOF), dtype=complex)
+                    Z_moor = jnp.zeros((nw, nDOF, nDOF),
+                                       dtype=jnp.asarray(Z6).dtype)
                     Z_moor = Z_moor.at[:, :6, :6].set(Z6)
                 else:
                     C_moor = C_moor.at[:6, :6].add(
@@ -483,7 +484,7 @@ class Model:
             F_lin = F_BEM[0] + exc["F_hydro_iner"][0]
 
             # second-order (difference-frequency) forces from external QTFs
-            F_2nd = jnp.zeros((nWaves, nDOF, nw), dtype=complex)
+            F_2nd = jnp.zeros((nWaves, nDOF, nw), dtype=F_lin.dtype)
             if F_2nd_mean is None:
                 F_2nd_mean = np.zeros((nWaves, self.nDOF))
             if self.qtf is not None and i == 0:
@@ -569,7 +570,8 @@ class Model:
 
         # ---- system impedance: block-diagonal FOWT impedances + shared
         # mooring stiffness (raft_model.py:1164-1182)
-        Z_sys = jnp.zeros((nw, self.nDOF, self.nDOF), dtype=complex)
+        Z_sys = jnp.zeros((nw, self.nDOF, self.nDOF),
+                          dtype=Z_blocks[0].dtype)
         for i in range(self.nFOWT):
             Z_sys = Z_sys.at[:, offs[i]:offs[i + 1], offs[i]:offs[i + 1]].add(
                 Z_blocks[i])
@@ -590,7 +592,7 @@ class Model:
             raise RuntimeError(
                 "NaN detected in response vector Xi (solveDynamics guard)")
         Xi = jnp.concatenate(
-            [Xi, jnp.zeros((1, self.nDOF, nw), dtype=complex)], axis=0)
+            [Xi, jnp.zeros((1, self.nDOF, nw), dtype=Xi.dtype)], axis=0)
         info0 = infos[0]
         return Xi, dict(
             Z=Z_sys, Bmat=Bmats[0], S=info0["S"], zeta=info0["zeta"],
@@ -912,7 +914,8 @@ class Model:
                 if dd is not None:
                     log_event("drag_linearisation", case=iCase, fowt=i,
                               resid=float(dd["drag_resid"]),
-                              converged=bool(dd["drag_converged"]))
+                              converged=bool(dd["drag_converged"]),
+                              n_iter=int(dd["n_iter_drag"]))
             # feed mean drift back into the equilibrium for ANY 2nd-order
             # configuration — the reference re-runs solveStatics with
             # Fhydro_2nd_mean whenever potSecOrder > 0, slender-body QTFs

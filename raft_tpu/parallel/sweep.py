@@ -55,6 +55,9 @@ def sweep_cases(evaluate, Hs, Tp, beta, mesh=None, out_keys=("PSD", "X0")):
     evaluate : scalar-case function from :func:`raft_tpu.api.make_case_evaluator`
     Hs/Tp/beta : (N,) arrays (N divisible by the dp axis size)
     """
+    from raft_tpu.utils.devices import enable_compile_cache
+
+    enable_compile_cache()
     if mesh is None:
         mesh = make_mesh()
     _check_dp_divisible(len(np.asarray(Hs)), mesh)
@@ -84,6 +87,9 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
 
     Returns the dict of stacked outputs (sharded jax arrays).
     """
+    from raft_tpu.utils.devices import enable_compile_cache
+
+    enable_compile_cache()
     if mesh is None:
         mesh = make_mesh()
     lengths = {k: len(np.asarray(v)) for k, v in cases.items()}
@@ -140,7 +146,9 @@ def run_sweep_checkpointed_full(evaluate, cases, out_dir, shard_size=256,
     silently poisoning downstream aggregates.
     """
     from raft_tpu.parallel import resilience
+    from raft_tpu.utils.devices import enable_compile_cache
 
+    enable_compile_cache()
     if mesh is None:
         mesh = resilience.resolve_mesh(make_mesh)
 
@@ -258,7 +266,9 @@ def run_sweep_checkpointed(evaluate, Hs, Tp, beta, out_dir, shard_size=256,
     :mod:`raft_tpu.parallel.resilience`.
     """
     from raft_tpu.parallel import resilience
+    from raft_tpu.utils.devices import enable_compile_cache
 
+    enable_compile_cache()
     if mesh is None:
         mesh = resilience.resolve_mesh(make_mesh)
     cases = {"Hs": np.asarray(Hs), "Tp": np.asarray(Tp),

@@ -382,15 +382,22 @@ def hydro_excitation(fs, ss, hc, zeta, beta, w, k, Tn, r_nodes):
 
 # --------------------------------------------------------- linearisation
 
-def hydro_linearization(fs, ss, hc, u_ih, Xi, w, Tn, r_nodes):
-    """Stochastic drag linearisation for one sea state.
+def drag_lin_precompute(fs, ss, hc, u_ih, Tn, r_nodes, w, dtype=None):
+    """Hoist everything Xi-independent out of the drag-linearisation
+    fixed point.
 
-    B' = sqrt(8/pi) * vRMS * 0.5 rho A Cd per strip/direction
-    (raft_member.py:2039-2126); returns the reduced damping matrix,
-    per-strip Bmat for the drag excitation, and F_hydro_drag.
+    The fixed point re-linearises per iteration, but only the response
+    ``Xi`` changes between iterations — strip areas, member-axis outer
+    products, wave-velocity projections, lever arms and the node gather
+    indices are all functions of geometry and sea state alone.
+    Precomputing them leaves :func:`drag_lin_iter` with the minimum
+    per-iteration work the math requires: the only remaining gather is
+    of the (iteration-dependent) node response, never of geometry
+    constants — guarded by tests/test_dynamics_hotpath.py.
 
-    u_ih : (S, 3, nw) wave velocity for the linearisation heading.
-    Xi   : (nDOF, nw) response amplitudes in reduced DOFs.
+    dtype : optional (real_dtype, complex_dtype) compute policy; the
+    precomputed tensors are cast so the iteration runs entirely in that
+    precision (see :mod:`raft_tpu.utils.dtypes`).
     """
     rho = fs.rho_water
     r, q, p1, p2 = hc["r"], hc["q"], hc["p1"], hc["p2"]
@@ -399,44 +406,197 @@ def hydro_linearization(fs, ss, hc, u_ih, Xi, w, Tn, r_nodes):
     circ = jnp.asarray(ss.circ)
     sub = hc["sub"]
 
-    # node motion at each strip: Xi at the strip's node + lever arm
     node_idx = jnp.asarray(ss.node)
-    Xi_nodes = jnp.einsum("nia,aw->niw", Tn, Xi)  # (N, 6, nw)
-    Xi_s = Xi_nodes[node_idx]  # (S, 6, nw)
-    r_off = r - r_nodes[node_idx]
-    _, vnode, _ = wv.get_kinematics(r_off, Xi_s, w)  # (S, 3, nw)
+    r_off = r - r_nodes[node_idx]             # (S, 3)
 
-    vrel = u_ih - vnode
+    c = jnp.sqrt(8.0 / jnp.pi) * 0.5 * rho
+    pre = dict(
+        q=q, p1=p1, p2=p2,
+        qq=tf.vec_vec_trans(q),
+        p1p1=tf.vec_vec_trans(p1),
+        p2p2=tf.vec_vec_trans(p2),
+        circ=circ, sub=sub,
+        # drag-coefficient prefactors sqrt(8/pi) * 0.5 rho A Cd
+        cq=c * a_q * jnp.asarray(ss.Cd_q),
+        cp1=c * a_p1 * jnp.asarray(ss.Cd_p1),
+        cp2=c * a_p2 * jnp.asarray(ss.Cd_p2),
+        # end/axial drag uses |a_end| (raft_member.py:2104-2113)
+        cEnd=c * a_end_abs * jnp.asarray(ss.Cd_End),
+        Tn=jnp.asarray(Tn), node_gather=node_idx, r_off=r_off,
+        H=tf.skew(r_off),
+        u=u_ih, iw=1j * jnp.asarray(w),
+    )
+    pre["node_idx"] = np.asarray(ss.node)     # static scatter targets
+    pre["n_nodes"] = fs.n_nodes
+
+    # Bmat is LINEAR in the three per-strip RMS coefficients c_d, so
+    # both node reductions fold into precomputed per-direction tensors
+    # and the per-iteration reduction collapses to weighted sums:
+    #
+    # * drag force: F3 = sum_d c_d axis_d (axis_d . u) is SEPARABLE —
+    #   the reduced 6-force direction e6_d = [axis_d, r_off x axis_d]
+    #   is real and Xi-independent, so with T6_d[s, a] the T-reduction
+    #   of e6_d and proj_d = axis_d . u_ih the per-strip projections,
+    #   F_hydro_drag[a, w] = sum_s T6_d[s, a] (c_d[s] proj_d[s, w]).
+    #   T6_d is (S, nDOF) — tiny at every nDOF, so this replaces the
+    #   whole per-iteration Bmat @ u / moment / segment-sum chain
+    #   unconditionally.
+    # * damping matrix: G_d[s] = Tn_s^T translate(P_d, r_off) Tn_s with
+    #   B_hydro_drag = sum_s c_d[s] G_d[s].  G_d is (S, nDOF, nDOF) —
+    #   folded only for small reduced models (nDOF <= 12); the N-DOF
+    #   flexible models keep the general segment-sum reduction, whose
+    #   B-side has no frequency axis and stays cheap.
+    nDOF = pre["Tn"].shape[-1]
+    Tn_s = pre["Tn"][node_idx]                # (S, 6, nDOF)
+
+    def reduce_force_dir(axis, proj):
+        e6 = jnp.concatenate([axis, jnp.cross(r_off, axis)], axis=-1)
+        T6 = jnp.einsum("sia,si->sa", Tn_s, e6)          # (S, nDOF)
+        return jnp.where(sub[:, None], T6, 0.0), proj
+
+    pre["T6q"], pre["uq"] = reduce_force_dir(
+        q, jnp.einsum("siw,si->sw", u_ih, q))
+    pre["T6p1"], pre["up1"] = reduce_force_dir(
+        p1, jnp.einsum("siw,si->sw", u_ih, p1))
+    pre["T6p2"], pre["up2"] = reduce_force_dir(
+        p2, jnp.einsum("siw,si->sw", u_ih, p2))
+
+    if nDOF <= 12:
+        H = pre["H"]
+        Ht = jnp.swapaxes(H, -1, -2)
+
+        def reduce_dir(P):
+            MH = P @ H
+            M6 = jnp.concatenate([
+                jnp.concatenate([P, MH], axis=-1),
+                jnp.concatenate([jnp.swapaxes(MH, -1, -2), H @ P @ Ht],
+                                axis=-1),
+            ], axis=-2)
+            G = jnp.einsum("sia,sij,sjb->sab", Tn_s, M6, Tn_s)
+            return jnp.where(sub[:, None, None], G, 0.0)
+
+        pre["Gq"] = reduce_dir(pre["qq"])
+        pre["Gp1"] = reduce_dir(pre["p1p1"])
+        pre["Gp2"] = reduce_dir(pre["p2p2"])
+    if dtype is not None:
+        rdt, cdt = dtype
+        pre = {
+            k2: (v.astype(cdt) if jnp.iscomplexobj(v)
+                 else v.astype(rdt) if jnp.issubdtype(v.dtype, jnp.floating)
+                 else v) if isinstance(v, jnp.ndarray) else v
+            for k2, v in pre.items()
+        }
+    return pre
+
+
+def drag_lin_iter(pre, Xi):
+    """One drag-linearisation evaluation at response ``Xi`` (nDOF, nw).
+
+    B' = sqrt(8/pi) * vRMS * 0.5 rho A Cd per strip/direction
+    (raft_member.py:2039-2126); returns the reduced damping matrix,
+    per-strip Bmat for the drag excitation, and F_hydro_drag — exactly
+    :func:`hydro_linearization`'s outputs, from the hoisted state.
+
+    Per-iteration work: the node responses (one small einsum + the one
+    response gather), relative-velocity RMS statistics, and the two
+    node reductions.  No geometry is rebuilt or re-gathered.
+    """
+    q, p1, p2 = pre["q"], pre["p1"], pre["p2"]
+    sub = pre["sub"]
+
+    # node motion at each strip: Xi at the strip's node + lever arm.
+    # i w is applied at NODE level (N << S rows) before the gather, so
+    # the strip-level work is one cross + one add (helpers.py:149-184
+    # getKinematics semantics, i w distributed over the sum; a fully
+    # folded (S, 3, nDOF) velocity operator measured SLOWER here — the
+    # elementwise gather+cross chain fuses, the extra dot does not)
+    Vn = pre["iw"] * jnp.einsum("nia,aw->niw", pre["Tn"], Xi)  # (N, 6, nw)
+    Vs = Vn[pre["node_gather"]]                                # (S, 6, nw)
+    rr = jnp.broadcast_to(pre["r_off"][:, :, None], Vs[:, 3:].shape)
+    vnode = Vs[:, :3] + jnp.cross(Vs[:, 3:], rr, axis=1)
+
+    # NOTE: projecting vrel (rather than precomputing the u_ih
+    # projections and subtracting the vnode ones) measured faster —
+    # the three dots fuse with the vrel construction, and it keeps the
+    # reference's exact summation order
+    vrel = pre["u"] - vnode
     vq_c = jnp.einsum("siw,si->sw", vrel, q)
     vp1_c = jnp.einsum("siw,si->sw", vrel, p1)
     vp2_c = jnp.einsum("siw,si->sw", vrel, p2)
-    vrel_q = vq_c[:, None, :] * q[:, :, None]
-    vrel_p = vrel - vrel_q
 
-    rms = lambda x: jnp.sqrt(0.5 * jnp.sum(jnp.abs(x) ** 2, axis=-1))
-    vRMS_q = rms(vq_c)
-    vRMS_p_tot = jnp.sqrt(0.5 * jnp.sum(jnp.abs(vrel_p) ** 2, axis=(1, 2)))
-    vRMS_p1 = jnp.where(circ, vRMS_p_tot, rms(vp1_c))
-    vRMS_p2 = jnp.where(circ, vRMS_p_tot, rms(vp2_c))
+    # |z|^2 as re^2 + im^2 (jnp.abs(z)**2 lowers to a hypot + square —
+    # a per-element sqrt the statistics never needed), and the
+    # transverse RMS through the orthogonal decomposition
+    # sum|vrel_p|^2 = sum|vrel|^2 - sum|vq_c|^2 (q is a unit axis), so
+    # the (S, 3, nw) vrel_p tensor is never materialised
+    a2 = lambda z: jnp.real(z) ** 2 + jnp.imag(z) ** 2
+    rms = lambda x: jnp.sqrt(0.5 * jnp.sum(a2(x), axis=-1))
+    vq2 = jnp.sum(a2(vq_c), axis=-1)                    # (S,)
+    vRMS_q = jnp.sqrt(0.5 * vq2)
+    tot2 = jnp.sum(a2(vrel), axis=(1, 2))               # (S,)
+    vRMS_p_tot = jnp.sqrt(0.5 * jnp.maximum(tot2 - vq2, 0.0))
+    vRMS_p1 = jnp.where(pre["circ"], vRMS_p_tot, rms(vp1_c))
+    vRMS_p2 = jnp.where(pre["circ"], vRMS_p_tot, rms(vp2_c))
 
-    c = jnp.sqrt(8.0 / jnp.pi) * 0.5 * rho
-    Bq = c * vRMS_q * a_q * jnp.asarray(ss.Cd_q)
-    Bp1 = c * vRMS_p1 * a_p1 * jnp.asarray(ss.Cd_p1)
-    Bp2 = c * vRMS_p2 * a_p2 * jnp.asarray(ss.Cd_p2)
-    # end/axial drag uses |a_end| (raft_member.py:2104-2113)
-    BEnd = c * vRMS_q * a_end_abs * jnp.asarray(ss.Cd_End)
+    Bq = vRMS_q * pre["cq"]
+    Bp1 = vRMS_p1 * pre["cp1"]
+    Bp2 = vRMS_p2 * pre["cp2"]
+    BEnd = vRMS_q * pre["cEnd"]
 
-    qq = tf.vec_vec_trans(q)
     Bmat = (
-        (Bq + BEnd)[:, None, None] * qq
-        + Bp1[:, None, None] * tf.vec_vec_trans(p1)
-        + Bp2[:, None, None] * tf.vec_vec_trans(p2)
+        (Bq + BEnd)[:, None, None] * pre["qq"]
+        + Bp1[:, None, None] * pre["p1p1"]
+        + Bp2[:, None, None] * pre["p2p2"]
     )
     Bmat = jnp.where(sub[:, None, None], Bmat, 0.0)
 
-    B_red = _reduce_matrix(Tn, ss.node, Bmat, r_off, fs.n_nodes)
-    F_drag = drag_excitation(fs, ss, hc, Bmat, u_ih, Tn, r_nodes)
+    # drag excitation through the separable fold (drag_lin_precompute):
+    # three (S, nDOF) x (c_d * proj_d) contractions replace the
+    # reference's Bmat @ u / moment / segment-sum chain
+    # (raft_member.py:2128-2152)
+    cq_ = Bq + BEnd
+    F_drag = (jnp.einsum("sa,sw->aw", pre["T6q"], cq_[:, None] * pre["uq"])
+              + jnp.einsum("sa,sw->aw", pre["T6p1"],
+                           Bp1[:, None] * pre["up1"])
+              + jnp.einsum("sa,sw->aw", pre["T6p2"],
+                           Bp2[:, None] * pre["up2"]))
+
+    if "Gq" in pre:
+        # folded damping reduction: three weighted sums replace the
+        # per-iteration translate + segment-sum + congruence chain
+        B_red = jnp.sum(
+            cq_[:, None, None] * pre["Gq"]
+            + Bp1[:, None, None] * pre["Gp1"]
+            + Bp2[:, None, None] * pre["Gp2"], axis=0)
+        return dict(B_hydro_drag=B_red, Bmat=Bmat, F_hydro_drag=F_drag)
+
+    # general (N-DOF) damping reduction with the precomputed lever-arm
+    # alternators
+    H = pre["H"]
+    MH = Bmat @ H
+    M6 = jnp.concatenate([
+        jnp.concatenate([Bmat, MH], axis=-1),
+        jnp.concatenate([jnp.swapaxes(MH, -1, -2),
+                         H @ Bmat @ jnp.swapaxes(H, -1, -2)], axis=-1),
+    ], axis=-2)
+    Mn = jax.ops.segment_sum(M6, pre["node_idx"], num_segments=pre["n_nodes"])
+    B_red = jnp.einsum("nia,nij,njb->ab", pre["Tn"], Mn, pre["Tn"])
     return dict(B_hydro_drag=B_red, Bmat=Bmat, F_hydro_drag=F_drag)
+
+
+def hydro_linearization(fs, ss, hc, u_ih, Xi, w, Tn, r_nodes):
+    """Stochastic drag linearisation for one sea state.
+
+    One-shot convenience wrapper over :func:`drag_lin_precompute` +
+    :func:`drag_lin_iter` (the fixed point in models/dynamics.py calls
+    the two stages directly so the precompute runs once, not per
+    iteration).
+
+    u_ih : (S, 3, nw) wave velocity for the linearisation heading.
+    Xi   : (nDOF, nw) response amplitudes in reduced DOFs.
+    """
+    pre = drag_lin_precompute(fs, ss, hc, u_ih, Tn, r_nodes, w)
+    return drag_lin_iter(pre, Xi)
 
 
 def drag_excitation(fs, ss, hc, Bmat, u_ih, Tn, r_nodes):

@@ -37,6 +37,46 @@ def to_host(tree):
     )
 
 
+def enable_compile_cache(cache_dir=None, platform=None,
+                         min_compile_secs=10.0):
+    """Enable the persistent XLA compilation cache (idempotent).
+
+    Promoted from the ad-hoc ``_enable_compile_cache`` in ``bench.py``
+    (mirroring the PR-1 ``probe_backend`` promotion) so library users —
+    the drivers in :mod:`raft_tpu.drivers` and the sweep runtimes in
+    :mod:`raft_tpu.parallel.sweep` — get cache hits across processes,
+    not just the bench.  Repeated driver retries / sweep resumes then
+    skip recompilation entirely.
+
+    cache_dir : cache location; default ``RAFT_TPU_CACHE_DIR``, else
+        ``~/.cache/raft_tpu/jax_cache``.
+    platform : optional platform pin (e.g. ``"cpu"``) — the axon TPU
+        plugin in this image overrides ``JAX_PLATFORMS`` at import
+        time, so an explicit platform request must go through the
+        config, not the env var.
+    min_compile_secs : only compilations at least this long persist.
+
+    Returns the cache directory in use (None when the cache could not
+    be enabled — e.g. jax already finalised its config).
+    """
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "RAFT_TPU_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu",
+                         "jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+    except Exception:
+        return None
+    return cache_dir
+
+
 def probe_backend(platform=None, timeout_s=None):
     """Health-probe an accelerator backend without risking this process.
 

@@ -1,0 +1,85 @@
+"""Explicit compute-dtype policy for the dynamics hot path.
+
+The drag-linearisation / impedance-solve chain historically allocated
+its complex intermediates with the hard-coded ``dtype=complex`` — under
+``jax_enable_x64`` that is complex128 *regardless* of the input dtypes,
+silently upcasting float32 pipelines; with x64 off it is complex64
+regardless of a float64 intent.  The policy here makes the choice
+explicit and overridable:
+
+* default (``RAFT_TPU_DTYPE`` unset): **derive from the inputs** — a
+  float64 golden-parity run stays float64 end to end, a float32 bench
+  batch stays float32/complex64;
+* ``RAFT_TPU_DTYPE=float32`` forces the float32/complex64 compute path
+  (the TPU-native pairing) even when the build-side tensors are f64;
+* ``RAFT_TPU_DTYPE=float64`` forces f64/complex128 (requires
+  ``jax_enable_x64``; silently canonicalised to f32 otherwise, as all
+  jax dtypes are).
+
+The env var is read at *trace* time: set it before building/jitting the
+evaluator whose precision you want to pin.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+
+_F32_NAMES = ("float32", "f32", "single", "complex64")
+_F64_NAMES = ("float64", "f64", "double", "complex128")
+
+
+def policy_name():
+    """The active policy string: '' (derive from inputs), 'float32' or
+    'float64'."""
+    p = os.environ.get("RAFT_TPU_DTYPE", "").strip().lower()
+    if not p:
+        return ""
+    if p in _F32_NAMES:
+        return "float32"
+    if p in _F64_NAMES:
+        return "float64"
+    raise ValueError(
+        f"RAFT_TPU_DTYPE={p!r}: expected 'float32', 'float64' or unset")
+
+
+def compute_dtypes(*arrays, policy=None):
+    """(real_dtype, complex_dtype) for hot-path compute.
+
+    ``policy``: explicit 'float32'/'float64' override; default reads
+    ``RAFT_TPU_DTYPE``, and with no policy set the real dtype is the
+    result type of the given arrays (so float64 inputs keep golden
+    parity and float32 inputs stay in the fast path).
+    """
+    if policy is None:
+        p = policy_name()
+    else:
+        p = str(policy or "").strip().lower()
+        if p and p not in _F32_NAMES + _F64_NAMES:
+            raise ValueError(
+                f"dtype policy {policy!r}: expected 'float32', 'float64' "
+                "or None")
+        p = ("float32" if p in _F32_NAMES else
+             "float64" if p in _F64_NAMES else "")
+    if p == "float32":
+        rdt = jnp.dtype(jnp.float32)
+    elif p == "float64":
+        rdt = jnp.dtype(jnp.float64)
+    else:
+        cands = [a for a in arrays if a is not None]
+        dt = jnp.result_type(*cands) if cands else jnp.result_type(float)
+        if jnp.issubdtype(dt, jnp.complexfloating):
+            rdt = jnp.dtype(jnp.float32 if dt == jnp.dtype(jnp.complex64)
+                            else jnp.float64)
+        elif jnp.issubdtype(dt, jnp.floating):
+            rdt = jnp.dtype(dt)
+        else:
+            rdt = jnp.dtype(jnp.result_type(float))
+    cdt = jnp.dtype(jnp.complex64 if rdt == jnp.dtype(jnp.float32)
+                    else jnp.complex128)
+    # canonicalise under the current x64 mode (f64 request with x64 off
+    # must not hand callers a dtype jax will refuse to materialise)
+    rdt = jnp.zeros((), dtype=rdt).dtype
+    cdt = jnp.zeros((), dtype=cdt).dtype
+    return rdt, cdt

@@ -1,0 +1,104 @@
+"""Parity and flag-gating tests for the batched small-N complex solver
+(:mod:`raft_tpu.ops.linsolve`) against the generic ``jnp.linalg.solve``
+LAPACK path, on real impedance matrices from the bundled designs and on
+synthetic systems across the supported size range."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.ops import linsolve
+
+EXAMPLES = "/root/reference/examples"
+BUNDLED = [
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "raft_tpu", "designs", "spar_demo.yaml"),
+    # reference example designs carry full aero chains — slow tier
+    pytest.param(os.path.join(EXAMPLES, "VolturnUS-S_example.yaml"),
+                 marks=pytest.mark.slow),
+    pytest.param(os.path.join(EXAMPLES, "OC3spar.yaml"),
+                 marks=pytest.mark.slow),
+]
+
+
+def _impedance_from_design(path):
+    import raft_tpu
+
+    model = raft_tpu.Model(path)
+    case = dict(model.cases[0]) if model.cases else {
+        "wave_spectrum": "JONSWAP", "wave_height": 4.0, "wave_period": 10.0,
+        "wave_heading": 0.0, "wind_speed": 0.0, "turbulence": 0.0,
+        "turbine_status": "operating", "yaw_misalign": 0.0,
+        "current_speed": 0.0, "current_heading": 0.0}
+    _, info = model.solve_dynamics(case)
+    return np.asarray(info["Z"])
+
+
+@pytest.mark.parametrize("path", BUNDLED)
+def test_native_matches_lapack_on_design_impedances(path):
+    """Native solver vs jnp.linalg.solve <= 1e-10 on the converged
+    impedance tensors of every bundled design (the tentpole's parity
+    gate)."""
+    if not os.path.exists(path):
+        pytest.skip("design unavailable in this container")
+    Z = _impedance_from_design(path)  # (nw, nDOF, nDOF) complex
+    nDOF = Z.shape[-1]
+    if nDOF > linsolve.MAX_NATIVE_N:
+        pytest.skip("native kernel only specialises N <= 12")
+    rng = np.random.default_rng(7)
+    F = (rng.normal(size=Z.shape[:-1]) + 1j * rng.normal(size=Z.shape[:-1]))
+    x_ref = np.asarray(linsolve.solve(jnp.asarray(Z), jnp.asarray(F),
+                                      path="lapack"))
+    x_nat = np.asarray(linsolve.solve(jnp.asarray(Z), jnp.asarray(F),
+                                      path="native"))
+    scale = np.max(np.abs(x_ref))
+    assert np.max(np.abs(x_nat - x_ref)) <= 1e-10 * scale
+
+
+@pytest.mark.parametrize("N", [1, 2, 3, 6, 9, 12])
+def test_native_synthetic_sizes(N):
+    """Impedance-structured random systems across the specialised size
+    range, with RHS batch broadcasting (the system_response layout)."""
+    rng = np.random.default_rng(N)
+    nw, nH = 17, 3
+    M = rng.normal(size=(N, N))
+    M = M @ M.T + N * np.eye(N)
+    C = rng.normal(size=(N, N))
+    C = C @ C.T + N * np.eye(N)
+    B = rng.normal(size=(N, N))
+    B = 0.05 * B @ B.T + 0.1 * np.eye(N)
+    w = np.linspace(0.01, 2.0, nw)
+    Z = -(w**2)[:, None, None] * M + 1j * w[:, None, None] * B + C
+    F = rng.normal(size=(nH, nw, N)) + 1j * rng.normal(size=(nH, nw, N))
+    x_ref = np.linalg.solve(Z[None], F[..., None])[..., 0]
+    x_nat = np.asarray(linsolve.solve(jnp.asarray(Z), jnp.asarray(F),
+                                      path="native"))
+    assert x_nat.shape == x_ref.shape
+    assert np.max(np.abs(x_nat - x_ref)) <= 1e-10 * np.max(np.abs(x_ref))
+
+
+def test_solver_flag(monkeypatch):
+    """RAFT_TPU_SOLVER gating: default native, explicit lapack, large-N
+    fallback, loud failure on typos."""
+    monkeypatch.delenv("RAFT_TPU_SOLVER", raising=False)
+    assert linsolve.solver_path(6) == "native"
+    assert linsolve.solver_path(linsolve.MAX_NATIVE_N + 1) == "lapack"
+    monkeypatch.setenv("RAFT_TPU_SOLVER", "lapack")
+    assert linsolve.solver_path(6) == "lapack"
+    monkeypatch.setenv("RAFT_TPU_SOLVER", "numpy")
+    with pytest.raises(ValueError):
+        linsolve.solver_path(6)
+
+
+def test_large_n_takes_lapack_even_when_forced(monkeypatch):
+    """A 16-DOF system routed with path='native' must still fall back —
+    the unrolled kernel is only generated for N <= MAX_NATIVE_N."""
+    rng = np.random.default_rng(0)
+    N = linsolve.MAX_NATIVE_N + 4
+    A = rng.normal(size=(N, N)) + 1j * rng.normal(size=(N, N)) + 3 * np.eye(N)
+    b = rng.normal(size=(N,)) + 0j
+    x = np.asarray(linsolve.solve(jnp.asarray(A), jnp.asarray(b),
+                                  path="native"))
+    assert np.allclose(A @ x, b, atol=1e-10)
