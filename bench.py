@@ -26,6 +26,8 @@ import time
 
 import numpy as np
 
+from raft_tpu.utils import config
+
 VOLTURN = "/root/reference/examples/VolturnUS-S_example.yaml"
 
 # 12-case table: operating turbine across the schedule, varied seas
@@ -332,9 +334,9 @@ def numpy_eval_case(model, case):
 
     # --- sea state + per-strip wave kinematics & excitation (strip loop)
     S = _jonswap_np(w, Hs, Tp)
-    zeta = np.sqrt(2 * S * dw).astype(complex)
-    Fexc = np.zeros((6, nw), dtype=complex)
-    u_all = np.zeros((ss.S, 3, nw), dtype=complex)
+    zeta = np.sqrt(2 * S * dw).astype(np.complex128)
+    Fexc = np.zeros((6, nw), dtype=np.complex128)
+    u_all = np.zeros((ss.S, 3, nw), dtype=np.complex128)
     for s in range(ss.S):
         u, ud, pd = _wavekin_np(zeta, beta, w, k, depth, r[s], rho, g)
         u_all[s] = u
@@ -357,11 +359,11 @@ def numpy_eval_case(model, case):
         (ss.ds[:, 0] + ss.drs[:, 0]) * (ss.ds[:, 1] + ss.drs[:, 1])
         - (ss.ds[:, 0] - ss.drs[:, 0]) * (ss.ds[:, 1] - ss.drs[:, 1])))
 
-    XiLast = np.zeros((6, nw), dtype=complex)
+    XiLast = np.zeros((6, nw), dtype=np.complex128)
     Xi = XiLast
     for _ in range(model.nIter + 1):
         B6 = np.zeros((6, 6))
-        Fdrag = np.zeros((6, nw), dtype=complex)
+        Fdrag = np.zeros((6, nw), dtype=np.complex128)
         for s in range(ss.S):  # strip loop, as the reference does
             if not sub[s]:
                 continue
@@ -391,7 +393,7 @@ def numpy_eval_case(model, case):
             Fdrag[:3] += F3
             Fdrag[3:] += np.cross(np.broadcast_to(lever[:, None], F3.shape), F3, axis=0)
 
-        Xi = np.zeros((6, nw), dtype=complex)
+        Xi = np.zeros((6, nw), dtype=np.complex128)
         for i in range(nw):  # frequency loop, as the reference does
             Z = -w[i] ** 2 * M + 1j * w[i] * (B6 + B_const[:, :, i]) + C
             Xi[:, i] = np.linalg.solve(Z, Fexc[:, i] + Fdrag[:, i])
@@ -431,7 +433,9 @@ def _wavekin_np(zeta, beta, w, k, h, r, rho, g):
     ze = zeta * np.exp(-1j * k * (np.cos(beta) * x + np.sin(beta) * y))
     if z > 0:
         nw = len(w)
-        return (np.zeros((3, nw), complex), np.zeros((3, nw), complex), np.zeros(nw, complex))
+        return (np.zeros((3, nw), np.complex128),
+                np.zeros((3, nw), np.complex128),
+                np.zeros(nw, np.complex128))
     kh = k * h
     deep = kh > 89.4
     with np.errstate(over="ignore"):
@@ -493,7 +497,7 @@ def _enable_compile_cache():
     enable_compile_cache(
         cache_dir=os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "_jax_cache"),
-        platform=os.environ.get("RAFT_TPU_BENCH_PLATFORM"))
+        platform=config.get("BENCH_PLATFORM") or None)
 
 
 BASELINE_ARTIFACT = os.path.join(
@@ -530,7 +534,7 @@ def _load_or_measure_baseline(max_measure_s):
     except Exception:
         pass
 
-    n_base = int(os.environ.get("RAFT_TPU_BENCH_NBASE", "1"))
+    n_base = config.get("BENCH_NBASE")
     model = _baseline_model()
     cases = [dict(wind_speed=c[0], wind_heading=c[1], turbulence=c[2],
                   wave_height=c[3], wave_period=c[4], wave_heading=c[5])
@@ -585,12 +589,12 @@ def main():
     import subprocess
     import sys
 
-    mode = os.environ.get("RAFT_TPU_BENCH_MODE", "")
+    mode = config.get("BENCH_MODE")
     if mode:
         run_mode(mode)
         return
 
-    budget = float(os.environ.get("RAFT_TPU_BENCH_BUDGET_S", "1350"))
+    budget = config.get("BENCH_BUDGET_S")
     t_start = time.perf_counter()
     base_eval_s, base_host = _load_or_measure_baseline(
         max_measure_s=min(120.0, 0.15 * budget))
@@ -601,11 +605,10 @@ def main():
     # One tiny matmul in a subprocess with a generous timeout settles it
     # up front (shared with the sweep runtime's CPU-fallback logic).
     device_ok = True
-    if not os.environ.get("RAFT_TPU_BENCH_PLATFORM"):
+    if not config.get("BENCH_PLATFORM"):
         from raft_tpu.utils.devices import probe_backend
 
-        device_ok = probe_backend(timeout_s=float(
-            os.environ.get("RAFT_TPU_BENCH_PROBE_S", "300")))
+        device_ok = probe_backend(timeout_s=config.get("BENCH_PROBE_S"))
 
     attempts = [("flat", 0.45), ("geom", 0.8)] if device_ok else []
     results = {}
@@ -648,7 +651,7 @@ def main():
     # error' at init).  A CPU number explicitly labelled as such beats
     # a third consecutive value=0 round; device_kind in the breakdown
     # plus the note keep it honest.
-    if not os.environ.get("RAFT_TPU_BENCH_PLATFORM"):
+    if not config.get("BENCH_PLATFORM"):
         remaining = budget - (time.perf_counter() - t_start) - 10.0
         env = dict(os.environ, RAFT_TPU_BENCH_MODE="flat",
                    RAFT_TPU_BENCH_PLATFORM="cpu",
@@ -677,13 +680,31 @@ def main():
     }))
 
 
+def _timed_reps(compiled, args, reps):
+    """Steady-state timing under the recompilation sentinel: warm up
+    first (first-dispatch helper compiles are not steady state), then
+    average ``reps`` executions, counting backend compiles inside them
+    (any nonzero count means the headline number includes XLA work)."""
+    import jax
+
+    from raft_tpu.analysis.recompile import count_compilations
+
+    jax.block_until_ready(compiled(*args))
+    with count_compilations() as clog:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(compiled(*args))
+        dt = (time.perf_counter() - t0) / reps
+    return dt, clog.count
+
+
 def _deadline_remaining(t_start):
     """Seconds left before the parent kills this attempt (None if run
     standalone)."""
-    d = os.environ.get("RAFT_TPU_BENCH_DEADLINE_S")
-    if not d:
+    d = config.get("BENCH_DEADLINE_S")
+    if d is None:
         return None
-    return float(d) - (time.perf_counter() - t_start)
+    return d - (time.perf_counter() - t_start)
 
 
 def _stage_times(jit_builder, args, reps, t_compile, dt, t_start):
@@ -703,7 +724,7 @@ def _stage_times(jit_builder, args, reps, t_compile, dt, t_start):
 
     remaining = _deadline_remaining(t_start)
     room = remaining is None or remaining > 2.4 * max(t_compile, 5.0) + 8 * dt
-    if os.environ.get("RAFT_TPU_BENCH_BREAKDOWN", "1") == "0" or not room:
+    if not config.get("BENCH_BREAKDOWN") or not room:
         return None, None
     try:
         def timed(f):
@@ -740,7 +761,7 @@ def _drag_iters(jit_raw_builder, args, t_compile, t_dyn, t_start):
 
 def _finish_breakdown(breakdown, t_compile, dt, t_stat, t_dyn,
                       base_per_sec, batch_designs, distinct_geometries,
-                      iters=None, ndof=6):
+                      iters=None, ndof=6, recompiles=None):
     """Shared breakdown block.  Stage prefixes are reported as RAW
     times of their own executables (differences between separately
     compiled programs can be negative and misattribute time); derived
@@ -760,6 +781,10 @@ def _finish_breakdown(breakdown, t_compile, dt, t_stat, t_dyn,
         drag_iterations_max=int(iters.max()) if iters is not None else None,
         per_drag_iteration_s=(round(drag_s / it_mean, 5)
                               if drag_s is not None and it_mean else None),
+        # recompilation sentinel (raft_tpu.analysis.recompile): backend
+        # compiles observed during the steady-state timing reps — any
+        # nonzero value means the headline number includes XLA work
+        steady_state_recompiles=recompiles,
     )
     breakdown.update(
         compile_s=round(t_compile, 2),
@@ -770,7 +795,7 @@ def _finish_breakdown(breakdown, t_compile, dt, t_stat, t_dyn,
                                     if t_dyn and t_stat else None),
         psd_tail_s=round(max(dt - t_dyn, 0.0), 4) if t_dyn else None,
         baseline_design_eval_s=round(1.0 / base_per_sec, 2),
-        baseline_host=os.environ.get("RAFT_TPU_BENCH_BASE_HOST"),
+        baseline_host=config.get("BENCH_BASE_HOST") or None,
         batch_designs=batch_designs,
         distinct_geometries=distinct_geometries,
     )
@@ -811,16 +836,9 @@ def run_mode(mode):
         return design_eval(g4, key=key)
 
     # batch of B DISTINCT design geometries x the 12-case table
-    B = int(os.environ.get("RAFT_TPU_BENCH_DESIGNS", "16"))
-    reps = int(os.environ.get("RAFT_TPU_BENCH_REPS", "3"))
+    B = config.get("BENCH_DESIGNS")
+    reps = config.get("BENCH_REPS")
     args = [jnp.asarray(sample_geometry(B), dtype=jnp.float32)]  # (B, 4)
-
-    def timed(f, *a):
-        jax.block_until_ready(f(*a))  # warm up (compile for jit fns)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            jax.block_until_ready(f(*a))
-        return (time.perf_counter() - t0) / reps
 
     fn = jax.jit(jax.vmap(eval_case))
     t_compile0 = time.perf_counter()
@@ -831,7 +849,7 @@ def run_mode(mode):
     # time the compiled executable directly — calling fn(*args) would
     # trigger a second, redundant compilation (lower().compile() does
     # not populate the jit cache)
-    dt = timed(compiled, *args)
+    dt, n_recompiles = _timed_reps(compiled, args, reps)
     design_evals_per_sec = B / dt
 
     t_stat, t_dyn = _stage_times(
@@ -844,7 +862,7 @@ def run_mode(mode):
 
     # optional profiler capture (point RAFT_TPU_PROFILE at a directory
     # and open the trace in TensorBoard / Perfetto)
-    prof_dir = os.environ.get("RAFT_TPU_PROFILE")
+    prof_dir = config.get("PROFILE")
     if prof_dir:
         with jax.profiler.trace(prof_dir):
             jax.block_until_ready(compiled(*args))
@@ -853,7 +871,7 @@ def run_mode(mode):
     breakdown = _finish_breakdown(
         _flops_breakdown(compiled, dt), t_compile, dt, t_stat, t_dyn,
         base_design_evals_per_sec, B, True, iters=iters,
-        ndof=model.fowtList[0].nDOF)
+        ndof=model.fowtList[0].nDOF, recompiles=n_recompiles)
     print(json.dumps({
         "metric": "design-evals/sec/chip (VolturnUS-S geometry DoE, 100w x 12 cases, operating turbine)",
         "value": round(design_evals_per_sec, 3),
@@ -874,7 +892,7 @@ def _flops_breakdown(compiled, dt):
         flops = float(compiled.cost_analysis()["flops"])
     except Exception:
         flops = None
-    peak_tf = float(os.environ.get("RAFT_TPU_PEAK_TFLOPS", "90"))
+    peak_tf = config.get("PEAK_TFLOPS")
     tflops = flops / dt / 1e12 if flops is not None else None
     return dict(
         xla_flops_per_batch=flops,
@@ -891,11 +909,11 @@ def _numpy_baseline(model):
     value (artifact or one bounded measurement) and passes it via env —
     measuring here would burn the attempt's deadline on a constant
     (the round-3/4 failure mode)."""
-    env_s = os.environ.get("RAFT_TPU_BENCH_BASE_EVAL_S")
+    env_s = config.get("BENCH_BASE_EVAL_S")
     if env_s:
-        return 1.0 / float(env_s)
+        return 1.0 / env_s
     n_cases = len(CASES)
-    n_base = int(os.environ.get("RAFT_TPU_BENCH_NBASE", "1"))
+    n_base = config.get("BENCH_NBASE")
     cases = [dict(wind_speed=c[0], wind_heading=c[1], turbulence=c[2],
                   wave_height=c[3], wave_period=c[4], wave_heading=c[5])
              for c in CASES]
@@ -931,8 +949,8 @@ def run_flat(t_start=None):
 
     n_cases = len(CASES)
     arr = np.array(CASES)
-    B = int(os.environ.get("RAFT_TPU_BENCH_DESIGNS", "16"))
-    reps = int(os.environ.get("RAFT_TPU_BENCH_REPS", "3"))
+    B = config.get("BENCH_DESIGNS")
+    reps = config.get("BENCH_REPS")
     tiled = np.tile(arr, (B, 1))
     args = [jnp.asarray(tiled[:, j], dtype=jnp.float32) for j in range(6)]
 
@@ -940,11 +958,7 @@ def run_flat(t_start=None):
     t0 = time.perf_counter()
     compiled = fn.lower(*args).compile()
     t_compile = time.perf_counter() - t0
-    jax.block_until_ready(compiled(*args))
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        jax.block_until_ready(compiled(*args))
-    dt = (time.perf_counter() - t0) / reps
+    dt, n_recompiles = _timed_reps(compiled, args, reps)
     design_evals_per_sec = B / dt
 
     t_stat, t_dyn = _stage_times(
@@ -958,7 +972,8 @@ def run_flat(t_start=None):
     base = _numpy_baseline(model)
     breakdown = _finish_breakdown(
         _flops_breakdown(compiled, dt), t_compile, dt, t_stat, t_dyn,
-        base, B, False, iters=iters, ndof=model.fowtList[0].nDOF)
+        base, B, False, iters=iters, ndof=model.fowtList[0].nDOF,
+        recompiles=n_recompiles)
     print(json.dumps({
         "metric": "design-evals/sec/chip (VolturnUS-S, 100w x 12 cases, operating turbine)",
         "value": round(design_evals_per_sec, 3),

@@ -1,0 +1,21 @@
+#!/bin/sh
+# CI lint gate: ruff (when installed) + the trace-hygiene linter.
+#
+# Runs next to the tier-1 suite (see README "Static analysis & trace
+# hygiene"):
+#     ./lint.sh && JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+#
+# The checked-in tree lints CLEAN — exit 1 means a new finding.
+# Suppress an audited exception inline with `# raft-lint: disable=<rule>`.
+set -e
+cd "$(dirname "$0")"
+
+if command -v ruff >/dev/null 2>&1; then
+    # error-class rules only (syntax errors, undefined names, misused
+    # comparisons): meaningful everywhere, no style churn
+    ruff check --quiet --select E9,F63,F7,F82 raft_tpu bench.py sweep_10k.py
+else
+    echo "lint.sh: ruff not installed; skipping ruff (custom linter still runs)"
+fi
+
+python -m raft_tpu.analysis lint

@@ -7,11 +7,12 @@ it — accelerator plugins without f64 support would otherwise fail.
 """
 
 import argparse
-import os
+
+from raft_tpu.utils import config
 
 
 def main():
-    platform = os.environ.get("RAFT_TPU_CLI_PLATFORM", "cpu")
+    platform = config.get("CLI_PLATFORM")
     if platform:
         import jax
 

@@ -1,0 +1,26 @@
+"""Trace-hygiene static analysis for the raft_tpu codebase.
+
+Three engines, one goal: the invariants that keep the per-ω impedance
+solve vmappable, compile-stable and dtype-tight are *machine-checked*
+instead of re-discovered as silent 10x slowdowns on a pod.
+
+* :mod:`raft_tpu.analysis.lint` — a custom AST linter for the bug
+  classes PR 2 fixed by hand: hard-coded complex/float64 dtype
+  literals in traced modules, host-Python coercions of traced values,
+  raw ``RAFT_TPU_*`` env reads outside the
+  :mod:`raft_tpu.utils.config` registry, and ``jax.jit`` call sites
+  missing ``static_argnames`` for config-like arguments.
+* :mod:`raft_tpu.analysis.jaxpr_contracts` — declarative contracts
+  checked against the *traced* jaxprs of the public entry points on
+  the bundled spar design: no geometry re-gathers inside the drag
+  fixed-point body, no host callbacks in hot paths, no 64-bit avals
+  under ``RAFT_TPU_DTYPE=float32``, and per-entry-point
+  primitive-count budgets against a checked-in baseline.
+* :mod:`raft_tpu.analysis.recompile` — a recompilation sentinel that
+  counts XLA backend compiles across repeated driver/sweep
+  invocations (second identical run must trigger zero).
+
+CLI: ``python -m raft_tpu.analysis {lint,contracts,baseline,flags}``.
+"""
+
+from raft_tpu.analysis.lint import Finding, lint_paths  # noqa: F401

@@ -1,0 +1,110 @@
+"""CLI for the trace-hygiene suite.
+
+    python -m raft_tpu.analysis lint [paths...]
+    python -m raft_tpu.analysis contracts [--design YAML] [--modes ...]
+    python -m raft_tpu.analysis baseline --write [--design YAML]
+    python -m raft_tpu.analysis flags
+
+Exit codes: 0 clean, 1 findings/violations, 2 usage error.  ``lint``
+and ``flags`` are jax-free; ``contracts``/``baseline`` trace the entry
+points and pin the CPU backend first (accelerator plugins in this
+image can hang backend init — the lint gate must never).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _cmd_lint(args):
+    from raft_tpu.analysis import lint
+
+    findings = lint.lint_paths(args.paths or None)
+    for f in findings:
+        print(f.format())
+    if findings:
+        print(f"{len(findings)} finding(s). Suppress intentional ones with "
+              "`# raft-lint: disable=<rule>`.", file=sys.stderr)
+        return 1
+    print("lint clean "
+          f"({len(args.paths) or len(lint.default_paths())} files).")
+    return 0
+
+
+def _pin_cpu():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+
+def _cmd_contracts(args, update_baseline=False):
+    _pin_cpu()
+    from raft_tpu.analysis import jaxpr_contracts as jc
+
+    modes = [m.strip() for m in args.modes.split(",") if m.strip()]
+    report = jc.run_checks(design=args.design, dtype_modes=modes,
+                           update_baseline=update_baseline)
+    for line in report["log"]:
+        print(line)
+    if report["violations"]:
+        print(f"{len(report['violations'])} contract violation(s):",
+              file=sys.stderr)
+        for v in report["violations"]:
+            print("  " + v, file=sys.stderr)
+        return 1
+    if update_baseline:
+        print(f"baseline written: {jc.baseline_path()}")
+    print("jaxpr contracts clean.")
+    return 0
+
+
+def _cmd_baseline(args):
+    if not args.write:
+        print("baseline is checked in; pass --write to regenerate "
+              "(after an intentional hot-path change)", file=sys.stderr)
+        return 2
+    return _cmd_contracts(args, update_baseline=True)
+
+
+def _cmd_flags(_args):
+    from raft_tpu.utils import config
+
+    rows = list(config.describe())
+    w = max(len(r[0]) for r in rows)
+    for env, kind, default, help_ in rows:
+        print(f"{env:<{w}}  {kind:<6}  default={default!r}  {help_}")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m raft_tpu.analysis")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("lint", help="run the trace-hygiene AST linter")
+    p.add_argument("paths", nargs="*", help="files to lint "
+                   "(default: raft_tpu/ + bench.py + sweep_10k.py)")
+
+    for name in ("contracts", "baseline"):
+        p = sub.add_parser(
+            name, help=("check jaxpr contracts + primitive budgets"
+                        if name == "contracts"
+                        else "regenerate the primitive-count baseline"))
+        p.add_argument("--design", default=None,
+                       help="design YAML (default: bundled spar_demo)")
+        p.add_argument("--modes", default="float64,float32",
+                       help="comma list of RAFT_TPU_DTYPE modes to trace")
+        if name == "baseline":
+            p.add_argument("--write", action="store_true")
+
+    sub.add_parser("flags", help="list the RAFT_TPU_* flag registry")
+
+    args = ap.parse_args(argv)
+    cmd = {"lint": _cmd_lint, "contracts": _cmd_contracts,
+           "baseline": _cmd_baseline, "flags": _cmd_flags}[args.cmd]
+    return cmd(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,383 @@
+"""Declarative jaxpr contracts for the traced hot paths.
+
+The public entry points — :func:`raft_tpu.models.dynamics.
+solve_dynamics_fowt`, :func:`~raft_tpu.models.dynamics.system_response`,
+:func:`raft_tpu.physics.morison.drag_lin_iter` and the design-sweep
+evaluator (:func:`raft_tpu.api.make_design_evaluator`) — are traced
+(``jax.make_jaxpr``, no compile/execute) on the bundled spar design and
+checked against contracts:
+
+* **structure** — hard per-primitive ceilings.  The central one
+  generalizes the PR-2 hand-written guard: the drag fixed-point body
+  may contain at most ONE ``gather`` (the iteration-dependent node
+  *response* lookup) and no ``dynamic_slice`` — geometry constants are
+  gathered once in ``drag_lin_precompute``, and reintroducing an
+  ``r_nodes[node_idx]``-style lookup into the iteration fails loudly;
+* **host isolation** — no callback/debug primitives anywhere in a hot
+  path (a single ``pure_callback`` serializes the whole pmapped solve);
+* **dtype tightness** — under ``RAFT_TPU_DTYPE=float32`` no equation
+  may *produce* a float64/complex128 value in the checked region: the
+  whole trace for the flat kernels (``drag_lin_iter``,
+  ``system_response``), the while/scan **loop bodies** for the
+  composite entries (their one-time build/staging prefix legitimately
+  manipulates f64 geometry constants before the downcast — the
+  fixed-point iterations must not);
+* **budget** — total and per-primitive equation counts within slack of
+  a checked-in baseline (``primitive_baseline.json`` next to this
+  module), so hot-path bloat fails with a primitive-level diff instead
+  of landing as a silent slowdown.  Regenerate after an intentional
+  change with ``python -m raft_tpu.analysis baseline --write``.
+
+Tracing pins ``RAFT_TPU_SOLVER=native`` and ``RAFT_TPU_SCAN_CHUNK`` to
+their defaults and traces BOTH fixed-point drivers ('while'/'scan') and
+BOTH dtype policies, so the baseline is reproducible on any host and
+the accelerator-path jaxpr is guarded from a CPU CI runner.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from collections import Counter
+from dataclasses import dataclass, field
+
+# primitives that round-trip through the host (or serialize the
+# program): never allowed in a traced hot path
+HOST_CALLBACK_PRIMS = (
+    "pure_callback", "io_callback", "callback", "debug_callback",
+    "debug_print", "host_callback_call", "outside_call",
+)
+
+_64BIT_DTYPES = ("float64", "complex128")
+
+DEFAULT_DESIGN = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "designs", "spar_demo.yaml")
+
+SPAR_CASE = {
+    "wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+    "turbine_status": "operating", "yaw_misalign": 0,
+    "wave_spectrum": "JONSWAP", "wave_period": 12, "wave_height": 6,
+    "wave_heading": 0, "current_speed": 0, "current_heading": 0,
+}
+
+# budget slack: the baseline is a snapshot, not a straitjacket — small
+# refactors (a fused where, an extra convert) must not flap CI, a
+# re-gather loop or an accidental unroll must fail.
+PRIM_RATIO, PRIM_ABS = 1.25, 4
+TOTAL_RATIO, TOTAL_ABS = 1.15, 16
+
+
+@dataclass(frozen=True)
+class Contract:
+    """Declarative limits for one entry point."""
+
+    name: str
+    max_prims: dict = field(default_factory=dict)  # prim -> hard ceiling
+    forbid_prims: tuple = HOST_CALLBACK_PRIMS
+    dtype_clean: str = "all"       # f32-policy scope: all | loops | ""
+    fixed_point_modes: tuple = ()  # trace per fp driver ('' = fp-free)
+
+
+CONTRACTS = {
+    # ONE gather allowed: the per-iteration node-response lookup.  The
+    # geometry gathers must stay in drag_lin_precompute.
+    "drag_lin_iter": Contract(
+        "drag_lin_iter", max_prims={"gather": 1, "dynamic_slice": 0}),
+    "system_response": Contract(
+        "system_response", max_prims={"gather": 0, "dynamic_slice": 0}),
+    "solve_dynamics_fowt": Contract(
+        "solve_dynamics_fowt", dtype_clean="loops",
+        fixed_point_modes=("while", "scan")),
+    # dtype contract intentionally off: the evaluator's statics /
+    # equilibrium Newton loop runs at BUILD precision (f64 closure
+    # constants under x64 hosts — the RAFT_TPU_DTYPE policy governs the
+    # dynamics hot path only); that interior is covered by the
+    # solve_dynamics_fowt entry above.
+    "design_evaluator": Contract(
+        "design_evaluator", dtype_clean="",
+        fixed_point_modes=("while", "scan")),
+}
+
+
+def baseline_path():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "primitive_baseline.json")
+
+
+# ------------------------------------------------------------ jaxpr walks
+
+def _subjaxprs(eqn):
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for x in vs:
+            inner = getattr(x, "jaxpr", x)
+            if hasattr(inner, "eqns"):
+                yield inner
+
+
+def count_primitives(jaxpr):
+    """Recursive primitive counter over an (closed)jaxpr, including
+    call/control-flow sub-jaxprs."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    counts = Counter()
+    for eqn in jaxpr.eqns:
+        counts[eqn.primitive.name] += 1
+        for inner in _subjaxprs(eqn):
+            counts.update(count_primitives(inner))
+    return counts
+
+
+def produced_64bit(jaxpr):
+    """(primitive, dtype) pairs for every equation whose *output* is a
+    64-bit float/complex, recursively.  Inputs/constants are exempt —
+    build-side f64 tensors may enter the trace, but only through an
+    immediate downcast (whose output is 32-bit)."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    hits = []
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is not None and str(dt) in _64BIT_DTYPES:
+                hits.append((eqn.primitive.name, str(dt)))
+        for inner in _subjaxprs(eqn):
+            hits.extend(produced_64bit(inner))
+    return hits
+
+
+def produced_64bit_in_loops(jaxpr):
+    """Like :func:`produced_64bit`, but only inside while/scan bodies —
+    the per-iteration compute that multiplies any 64-bit leak by the
+    trip count (and the batch)."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    hits = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in ("while", "scan"):
+            for inner in _subjaxprs(eqn):
+                hits.extend(produced_64bit(inner))
+        else:
+            for inner in _subjaxprs(eqn):
+                hits.extend(produced_64bit_in_loops(inner))
+    return hits
+
+
+# ---------------------------------------------------------------- tracing
+
+@contextlib.contextmanager
+def _flag_env(**flags):
+    """Pin RAFT_TPU_* flags for the duration of a trace (values of None
+    unset the variable)."""
+    old = {}
+    try:
+        for k, v in flags.items():
+            env = "RAFT_TPU_" + k
+            old[env] = os.environ.get(env)
+            if v is None:
+                os.environ.pop(env, None)
+            else:
+                os.environ[env] = str(v)
+        yield
+    finally:
+        for env, v in old.items():
+            if v is None:
+                os.environ.pop(env, None)
+            else:
+                os.environ[env] = v
+
+
+class EntryPointTracer:
+    """Builds the bundled model once and traces each entry point under a
+    given (dtype_policy, fixed_point) variant."""
+
+    def __init__(self, design=None):
+        import raft_tpu
+
+        self.model = raft_tpu.Model(design or DEFAULT_DESIGN)
+        fh = self.model.hydro[0]
+        fh.hydro_excitation(SPAR_CASE)
+        self.fs = self.model.fowtList[0]
+        self.fh = fh
+
+    def variants(self, entry, dtype_modes):
+        """Variant keys to trace/check for an entry: 'float64+while',
+        'float32' (fp-free entries omit the driver part)."""
+        fp_modes = CONTRACTS[entry].fixed_point_modes or ("",)
+        return [d + ("+" + f if f else "")
+                for d in dtype_modes for f in fp_modes]
+
+    def trace(self, entry, variant):
+        """ClosedJaxpr of ``entry`` under ``variant`` (no execution)."""
+        import jax
+        import jax.numpy as jnp
+
+        from raft_tpu.models.dynamics import (solve_dynamics_fowt,
+                                              system_response)
+        from raft_tpu.physics import morison
+        from raft_tpu.utils.dtypes import compute_dtypes
+
+        dtype, _, fp = variant.partition("+")
+        model, fs, fh = self.model, self.fs, self.fh
+        nDOF, nw = fs.nDOF, model.nw
+        with _flag_env(DTYPE=dtype, FIXED_POINT=fp or None,
+                       SOLVER="native", SCAN_CHUNK=None):
+            rdt, cdt = compute_dtypes(policy=dtype)
+            w = jnp.asarray(model.w, dtype=rdt)
+            if entry == "drag_lin_iter":
+                pre = morison.drag_lin_precompute(
+                    fs, fh.strips, fh.hc, jnp.asarray(fh.u[0]).astype(cdt),
+                    fh.Tn, fh.r_nodes, w, dtype=(rdt, cdt))
+                Xi0 = jnp.full((nDOF, nw), 0.1 + 0j, dtype=cdt)
+                return jax.make_jaxpr(
+                    lambda Xi: morison.drag_lin_iter(pre, Xi))(Xi0)
+            if entry == "system_response":
+                Z = jnp.zeros((nw, nDOF, nDOF), dtype=cdt)
+                F = jnp.zeros((2, nDOF, nw), dtype=cdt)
+                return jax.make_jaxpr(system_response)(Z, F)
+            if entry == "solve_dynamics_fowt":
+                def solve(M, B, C, F, u0):
+                    return solve_dynamics_fowt(
+                        fs, fh.strips, fh.hc, u0, M, B, C, F, w,
+                        fh.Tn, fh.r_nodes, n_iter=model.nIter,
+                        Xi_start=model.XiStart)
+                return jax.make_jaxpr(solve)(
+                    jnp.zeros((nDOF, nDOF, nw), dtype=rdt),
+                    jnp.zeros((nDOF, nDOF, nw), dtype=rdt),
+                    jnp.zeros((nDOF, nDOF), dtype=rdt),
+                    jnp.zeros((nDOF, nw), dtype=cdt),
+                    jnp.asarray(fh.u[0]).astype(cdt))
+            if entry == "design_evaluator":
+                from raft_tpu.api import make_design_evaluator
+
+                # rebuilt per variant: the evaluator reads the dtype
+                # policy at trace time through its closure constants
+                ev = make_design_evaluator(model)
+                return jax.make_jaxpr(lambda p: ev(
+                    {"Hs": p[0], "Tp": p[1], "beta": p[2],
+                     "Cd_scale": p[3]}))(
+                    jnp.asarray([6.0, 12.0, 0.0, 1.0], dtype=rdt))
+        raise KeyError(f"unknown entry point {entry!r}")
+
+
+# --------------------------------------------------------------- checking
+
+def check_structure(entry, variant, jaxpr):
+    """Contract violations (list of strings) for one traced variant —
+    structural caps, host isolation, and the float32 dtype contract."""
+    c = CONTRACTS[entry]
+    counts = count_primitives(jaxpr)
+    out = []
+    for prim, cap in c.max_prims.items():
+        if counts.get(prim, 0) > cap:
+            out.append(
+                f"{entry}[{variant}]: {counts[prim]} x {prim} "
+                f"(contract allows {cap}) — hoist the lookup into the "
+                "precompute stage")
+    for prim in c.forbid_prims:
+        if counts.get(prim, 0):
+            out.append(f"{entry}[{variant}]: host callback primitive "
+                       f"{prim!r} in a hot path")
+    if c.dtype_clean and variant.startswith("float32"):
+        finder = (produced_64bit if c.dtype_clean == "all"
+                  else produced_64bit_in_loops)
+        hits = finder(jaxpr)
+        if hits:
+            where = ("" if c.dtype_clean == "all"
+                     else " inside fixed-point loop bodies")
+            sample = ", ".join(f"{p}->{d}" for p, d in hits[:5])
+            out.append(
+                f"{entry}[{variant}]: {len(hits)} equation(s) produce "
+                f"64-bit values under RAFT_TPU_DTYPE=float32{where} "
+                f"({sample}" + (", ..." if len(hits) > 5 else "") + ")")
+    return out
+
+
+def check_budget(entry, variant, counts, baseline):
+    """Budget violations against the stored baseline counters, with a
+    primitive-level diff in the message."""
+    base = (baseline.get("entries", {}).get(entry, {}).get(variant))
+    if base is None:
+        return [f"{entry}[{variant}]: no baseline entry — run "
+                "`python -m raft_tpu.analysis baseline --write`"]
+    out = []
+    total = sum(counts.values())
+    cap = int(base["total"] * TOTAL_RATIO + TOTAL_ABS)
+    if total > cap:
+        grew = {p: (base["prims"].get(p, 0), n)
+                for p, n in counts.most_common()
+                if n > base["prims"].get(p, 0)}
+        diff = ", ".join(f"{p}: {b}->{n}" for p, (b, n) in
+                         list(grew.items())[:8])
+        out.append(
+            f"{entry}[{variant}]: total primitive count {total} exceeds "
+            f"budget {cap} (baseline {base['total']}); grew: {diff}")
+    for p, n in counts.items():
+        b = base["prims"].get(p, 0)
+        if n > int(b * PRIM_RATIO + PRIM_ABS):
+            out.append(
+                f"{entry}[{variant}]: {p} x{n} exceeds budget "
+                f"{int(b * PRIM_RATIO + PRIM_ABS)} (baseline {b})")
+    return out
+
+
+def load_baseline(path=None):
+    path = path or baseline_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def run_checks(design=None, dtype_modes=("float64", "float32"),
+               update_baseline=False, entries=None, budget=True,
+               tracer=None):
+    """Trace every entry-point variant and check all contracts.
+
+    Returns ``{"violations": [...], "log": [...], "counts": {...}}``.
+    With ``update_baseline`` the measured counts replace the stored
+    baseline (and budget checking is skipped).  ``tracer`` reuses an
+    existing :class:`EntryPointTracer` (tests share one model build).
+    """
+    tracer = tracer or EntryPointTracer(design)
+    baseline = load_baseline()
+    design_name = os.path.basename(design or DEFAULT_DESIGN)
+    if (budget and not update_baseline and baseline
+            and baseline.get("design") != design_name):
+        # comparing another design against the spar snapshot would
+        # produce noise either way (spurious violations, or silently
+        # loosened budgets) — refuse instead
+        return {"violations": [
+            f"primitive baseline was recorded for "
+            f"{baseline.get('design')!r}, not {design_name!r}; run "
+            "`python -m raft_tpu.analysis baseline --write "
+            f"--design {design_name}` or check the bundled design"],
+            "log": [], "counts": {}}
+    violations, log = [], []
+    measured = {}
+    for entry in (entries or CONTRACTS):
+        measured[entry] = {}
+        for variant in tracer.variants(entry, tuple(dtype_modes)):
+            jaxpr = tracer.trace(entry, variant)
+            counts = count_primitives(jaxpr)
+            measured[entry][variant] = {
+                "total": sum(counts.values()),
+                "prims": dict(sorted(counts.items()))}
+            log.append(f"{entry}[{variant}]: "
+                       f"{sum(counts.values())} primitives")
+            violations += check_structure(entry, variant, jaxpr)
+            if budget and not update_baseline:
+                violations += check_budget(entry, variant, counts, baseline)
+    if update_baseline and not violations:
+        import jax
+
+        payload = dict(
+            design=os.path.basename(design or DEFAULT_DESIGN),
+            jax=jax.__version__,
+            pinned_flags=dict(SOLVER="native", SCAN_CHUNK="default"),
+            slack=dict(prim_ratio=PRIM_RATIO, prim_abs=PRIM_ABS,
+                       total_ratio=TOTAL_RATIO, total_abs=TOTAL_ABS),
+            entries=measured)
+        with open(baseline_path(), "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+    return {"violations": violations, "log": log, "counts": measured}

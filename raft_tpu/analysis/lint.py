@@ -1,0 +1,447 @@
+"""Custom AST linter for trace hygiene.
+
+Four rules, each targeting a bug class that has actually bitten this
+codebase (or was fixed by hand in PR 2 and must stay fixed):
+
+``dtype-literal``
+    Hard-coded complex/float64 dtype literals.  ``dtype=complex`` is
+    complex128 under ``jax_enable_x64`` *regardless of the input
+    dtypes* — the silent-upcast class that turned f32 pipelines into
+    complex128 ones.  On ``jnp`` calls any 64-bit or bare literal is
+    flagged (use :mod:`raft_tpu.utils.dtypes` to derive from inputs or
+    the policy); on host (numpy) calls only the width-ambiguous bare
+    ``complex`` is flagged (write ``np.complex128`` when double
+    precision is the audited intent).
+
+``host-coercion``
+    ``float()``/``int()``/``bool()``/``.item()``/``np.asarray()``
+    applied to values that dataflow from a ``jnp`` expression inside
+    the same function: under ``jit`` these raise ``TracerError`` or —
+    worse, outside jit — silently pull the value to host and block
+    async dispatch.  Applies only to the declared ``TRACED_MODULES``
+    (host-orchestration modules pull eager results to numpy on
+    purpose); shape/len metadata access is exempt.
+
+``env-read``
+    Raw ``os.environ``/``os.getenv`` reads of ``RAFT_TPU_*`` names
+    anywhere except the central registry
+    (:mod:`raft_tpu.utils.config`): unregistered reads are exactly how
+    flag typos fail silently.
+
+``jit-static``
+    ``jax.jit`` call sites whose wrapped function takes config-like
+    parameters (``mode``, ``n_*``, ``*_path``, ``out_keys``, ...)
+    without declaring ``static_argnames``/``static_argnums`` — traced
+    config args either crash at trace time or recompile per value.
+
+Suppression: append ``# raft-lint: disable=<rule>[,<rule>]`` to the
+offending line (or put it alone on the line above); a file-level
+``# raft-lint: disable-file=<rule>`` comment disables a rule for the
+whole file.  Suppressing ``all`` disables every rule.
+
+The linter is pure stdlib ``ast`` — no jax import — so it runs in CI
+without touching a backend.  Run ``python -m raft_tpu.analysis lint``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+RULES = {
+    "dtype-literal": "hard-coded complex/float64 dtype literal",
+    "host-coercion": "host-Python coercion of a traced value",
+    "env-read": "raw RAFT_TPU_* env read outside raft_tpu.utils.config",
+    "jit-static": "jax.jit of config-like args without static_argnames",
+}
+
+# modules whose code runs under jax tracing: the host-coercion rule
+# only applies here.  Host-orchestration modules (drivers, outputs,
+# plotting, the float64 parity path in models/model.py) legitimately
+# pull eager jax values to numpy; the traced modules must never.
+# Paths are repo-relative '/'-separated prefixes.
+TRACED_MODULES = (
+    "raft_tpu/ops/",
+    "raft_tpu/models/dynamics.py",
+    "raft_tpu/physics/morison.py",
+    "raft_tpu/api.py",
+    "raft_tpu/structure/members_traced.py",
+    "raft_tpu/structure/topology_traced.py",
+)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*raft-lint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[a-z\-,\s]+)")
+
+_CONFIG_PARAM_RE = re.compile(
+    r"^(n_|num_)"
+    # NB: bare `key`/`keys` are NOT config-like — a PRNG `key` param is
+    # idiomatic jax and must stay traced (making it static would force
+    # a compile per key, the storm this suite exists to prevent)
+    r"|^(mode|modes|path|paths|policy|dtype|static|config|cfg|flag|flags"
+    r"|method|kind|option|options|out_keys|nWaves|chunk)$"
+    r"|(_mode|_path|_dir|_flag|_keys|_name|_names|_kind)$")
+
+# dtype literals that hard-code a 64-bit (or width-ambiguous) choice
+_BAD_DTYPE_STRINGS = ("complex", "complex128", "float64")
+_BAD_DTYPE_ATTRS = ("complex128", "float64", "complex_", "float_")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self):
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+def _attr_root(node):
+    """Leftmost name of a dotted expression ('jnp' for jnp.zeros,
+    'np' for np.ctypeslib.ndpointer), or None."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_jnp_root(root):
+    return root in ("jnp", "jax")
+
+
+def _is_np_root(root):
+    return root in ("np", "numpy")
+
+
+class _Suppressions:
+    """Per-file suppression table parsed from comments."""
+
+    def __init__(self, source):
+        self.by_line = {}
+        self.file_level = set()
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+            if m.group("file"):
+                self.file_level |= rules
+            else:
+                self.by_line.setdefault(i, set()).update(rules)
+                # a standalone suppression comment covers the next line
+                if text.lstrip().startswith("#"):
+                    self.by_line.setdefault(i + 1, set()).update(rules)
+
+    def active(self, rule, line):
+        for scope in (self.file_level, self.by_line.get(line, ())):
+            if rule in scope or "all" in scope:
+                return True
+        return False
+
+
+class _TaintScope:
+    """Names in the current function known to flow from jnp expressions."""
+
+    def __init__(self, parent=None):
+        self.names = set(parent.names) if parent else set()
+
+    def expr_tainted(self, node):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and (
+                    sub.id in self.names or sub.id == "jnp"):
+                return True
+        return False
+
+
+def _coercion_arg_is_hostlike(node):
+    """Shape/size/len() accesses are host metadata even on tracers —
+    coercing them is fine and extremely common."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in (
+                "shape", "ndim", "size", "dtype"):
+            return True
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "len":
+            return True
+    return False
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path, display_path, source, rules):
+        self.path = path
+        self.display = display_path
+        self.rules = rules
+        self.suppress = _Suppressions(source)
+        self.findings = []
+        self.scopes = [_TaintScope()]
+        # all named function defs, innermost visible wins (for the
+        # jit-static rule's call-target resolution)
+        self.defs = {}
+        tree = ast.parse(source, filename=path)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, node)
+        self.visit(tree)
+
+    # ------------------------------------------------------------- helpers
+
+    def _emit(self, rule, node, message):
+        if rule not in self.rules:
+            return
+        if self.suppress.active(rule, node.lineno):
+            return
+        self.findings.append(Finding(
+            self.display, node.lineno, node.col_offset + 1, rule, message))
+
+    # ------------------------------------------------------------- scoping
+
+    def visit_FunctionDef(self, node):
+        self.scopes.append(_TaintScope(self.scopes[-1]))
+        self.generic_visit(node)
+        self.scopes.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        if self.scopes[-1].expr_tainted(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.scopes[-1].names.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for e in t.elts:
+                        if isinstance(e, ast.Name):
+                            self.scopes[-1].names.add(e.id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name) and \
+                self.scopes[-1].expr_tainted(node.value):
+            self.scopes[-1].names.add(node.target.id)
+        self.generic_visit(node)
+
+    # --------------------------------------------------------------- rules
+
+    def visit_Call(self, node):
+        self._check_dtype_literal(node)
+        self._check_host_coercion(node)
+        self._check_env_read(node)
+        self._check_jit_static(node)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        # os.environ["RAFT_TPU_X"]
+        if isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "environ" \
+                and _attr_root(node.value) == "os":
+            key = node.slice
+            if isinstance(key, ast.Constant) and isinstance(key.value, str) \
+                    and key.value.startswith("RAFT_TPU_"):
+                self._emit("env-read", node,
+                           f"os.environ[{key.value!r}] outside the flag "
+                           "registry; use raft_tpu.utils.config")
+        self.generic_visit(node)
+
+    # positional index of the dtype arg on the common constructors, so
+    # `jnp.zeros((6, nw), complex)` is caught as well as the kwarg form
+    _DTYPE_ARG_POS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
+                      "asarray": 1, "array": 1}
+
+    def _check_dtype_literal(self, node):
+        root = _attr_root(node.func) if isinstance(
+            node.func, (ast.Attribute, ast.Name)) else None
+        jnp_call = _is_jnp_root(root)
+        values = [kw.value for kw in node.keywords if kw.arg == "dtype"]
+        if isinstance(node.func, ast.Attribute):
+            # x.astype(complex) — positional dtype
+            if node.func.attr == "astype" and node.args:
+                values.append(node.args[0])
+            pos = self._DTYPE_ARG_POS.get(node.func.attr)
+            if pos is not None and len(node.args) > pos:
+                values.append(node.args[pos])
+        for v in values:
+            if isinstance(v, ast.Name) and v.id == "complex":
+                self._emit(
+                    "dtype-literal", v,
+                    "bare `complex` dtype is complex128 under x64 (silent "
+                    "upcast); derive from inputs via "
+                    "raft_tpu.utils.dtypes.compute_dtypes, or write "
+                    "np.complex128 for audited host-side precision")
+            elif jnp_call and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, str) \
+                    and v.value.lower() in _BAD_DTYPE_STRINGS:
+                self._emit(
+                    "dtype-literal", v,
+                    f"hard-coded dtype {v.value!r} on a jnp call pins a "
+                    "64-bit width; derive from inputs or the "
+                    "RAFT_TPU_DTYPE policy")
+            elif jnp_call and isinstance(v, ast.Attribute) \
+                    and v.attr in _BAD_DTYPE_ATTRS:
+                self._emit(
+                    "dtype-literal", v,
+                    f"hard-coded dtype .{v.attr} on a jnp call pins a "
+                    "64-bit width; derive from inputs or the "
+                    "RAFT_TPU_DTYPE policy")
+
+    def _check_host_coercion(self, node):
+        scope = self.scopes[-1]
+        # float(x) / int(x) / bool(x) / complex(x)
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int", "bool", "complex") \
+                and len(node.args) == 1:
+            arg = node.args[0]
+            if scope.expr_tainted(arg) and not _coercion_arg_is_hostlike(arg):
+                self._emit(
+                    "host-coercion", node,
+                    f"{node.func.id}() on a traced (jnp-derived) value "
+                    "breaks tracing / forces a host sync; keep it as an "
+                    "array op (jnp.asarray / astype)")
+        # x.item() / x.tolist()
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("item", "tolist") and not node.args:
+            if scope.expr_tainted(node.func.value):
+                self._emit(
+                    "host-coercion", node,
+                    f".{node.func.attr}() on a traced (jnp-derived) value "
+                    "forces a device->host transfer inside the hot path")
+        # np.asarray(x) / np.array(x) on a traced value
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("asarray", "array") \
+                and _is_np_root(_attr_root(node.func)) and node.args:
+            arg = node.args[0]
+            if scope.expr_tainted(arg) and not _coercion_arg_is_hostlike(arg):
+                self._emit(
+                    "host-coercion", node,
+                    "np.asarray/np.array on a jnp value pulls it to host "
+                    "(blocks async dispatch); use jnp.asarray or move the "
+                    "pull out of the traced path")
+
+    def _check_env_read(self, node):
+        if not isinstance(node.func, ast.Attribute):
+            return
+        is_environ_get = (node.func.attr in ("get", "setdefault")
+                          and isinstance(node.func.value, ast.Attribute)
+                          and node.func.value.attr == "environ"
+                          and _attr_root(node.func.value) == "os")
+        is_getenv = (node.func.attr == "getenv"
+                     and _attr_root(node.func) == "os")
+        if not (is_environ_get or is_getenv) or not node.args:
+            return
+        key = node.args[0]
+        if isinstance(key, ast.Constant) and isinstance(key.value, str) \
+                and key.value.startswith("RAFT_TPU_"):
+            self._emit(
+                "env-read", node,
+                f"raw read of {key.value!r} outside the flag registry; "
+                "register it in raft_tpu/utils/config.py and use "
+                "config.get/config.raw")
+
+    def _check_jit_static(self, node):
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "jit"
+                and _attr_root(node.func) == "jax"):
+            return
+        kwarg_names = {kw.arg for kw in node.keywords}
+        if kwarg_names & {"static_argnames", "static_argnums"}:
+            return
+        if not node.args:
+            return
+        target = node.args[0]
+        if isinstance(target, ast.Name):
+            target = self.defs.get(target.id)
+        if not isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+            return  # jax.jit(vmap(...)) etc.: not resolvable statically
+        args = target.args
+        params = [a.arg for a in
+                  args.posonlyargs + args.args + args.kwonlyargs]
+        suspicious = [p for p in params if _CONFIG_PARAM_RE.search(p)]
+        if suspicious:
+            self._emit(
+                "jit-static", node,
+                "jax.jit wraps config-like parameter(s) "
+                f"{', '.join(repr(p) for p in suspicious)} without "
+                "static_argnames — traced config args fail at trace time "
+                "or recompile per value")
+
+
+# ----------------------------------------------------------------- driver
+
+def repo_root():
+    """The repository root (two levels above this package)."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_paths(root=None):
+    """The default lint scan set: the whole ``raft_tpu`` package plus
+    the repo-level bench/sweep scripts (tests and fixtures excluded)."""
+    root = root or repo_root()
+    paths = []
+    pkg = os.path.join(root, "raft_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                paths.append(os.path.join(dirpath, fn))
+    for fn in ("bench.py", "sweep_10k.py"):
+        p = os.path.join(root, fn)
+        if os.path.exists(p):
+            paths.append(p)
+    return paths
+
+
+def _rules_for(display_path):
+    """Rule set by file role: the registry itself is exempt from
+    env-read (it IS the sanctioned reader), and host-coercion only
+    applies to the declared traced modules."""
+    rules = set(RULES)
+    norm = display_path.replace(os.sep, "/")
+    if norm.endswith("raft_tpu/utils/config.py"):
+        rules.discard("env-read")
+    if not any(norm.startswith(p) or norm.endswith(p)
+               for p in TRACED_MODULES):
+        rules.discard("host-coercion")
+    return rules
+
+
+def lint_file(path, display_path=None, source=None, rules=None):
+    """Lint one file; returns a list of :class:`Finding`.
+
+    ``rules`` overrides the path-based rule selection (the fixture
+    tests force every rule on regardless of location)."""
+    display = display_path or os.path.relpath(path, repo_root())
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    try:
+        return _Linter(path, display, source,
+                       rules or _rules_for(display)).findings
+    except SyntaxError as e:
+        return [Finding(display, e.lineno or 1, (e.offset or 0) + 1,
+                        "syntax", f"cannot parse: {e.msg}")]
+
+
+def lint_paths(paths=None, root=None):
+    """Lint many files (default: :func:`default_paths`); directory
+    paths are walked for ``*.py``; findings are sorted by path/line for
+    stable CI output."""
+    expanded = []
+    for p in (paths or default_paths(root)):
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                expanded += [os.path.join(dirpath, fn)
+                             for fn in sorted(filenames)
+                             if fn.endswith(".py")]
+        else:
+            expanded.append(p)
+    findings = []
+    for p in expanded:
+        findings.extend(lint_file(p))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,104 @@
+"""Recompilation sentinel: count XLA backend compiles, assert budgets.
+
+A recompilation storm is the quiet failure mode of a jit-heavy
+pipeline: a shape that varies per call, a config arg traced instead of
+static, a closure rebuilt per invocation — each turns a cached-in-
+microseconds dispatch into seconds of XLA work, silently.  The
+sentinel makes the count observable and assertable:
+
+    from raft_tpu.analysis import recompile
+
+    with recompile.count_compilations() as log:
+        run_sweep(...)
+    print(log.count)
+
+    # steady state must be compile-free: second identical run => 0
+    run_sweep(...)                       # warm (compiles, fills caches)
+    with recompile.assert_compile_budget(0):
+        run_sweep(...)                   # identical -> raises if any
+
+Counting hooks jax's own monitoring stream (the
+``/jax/core/compile/backend_compile_duration`` event fires once per
+actual backend compilation, cache hits don't emit it), so eager-op
+compiles are counted too — exactly the ones that sneak past
+jit-centric reasoning.  ``bench.py`` reports the steady-state count in
+its breakdown (``steady_state_recompiles``), and
+``tests/test_trace_contracts.py`` asserts the zero-budget invariant on
+a repeated sweep invocation in the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class RecompilationError(AssertionError):
+    """More backend compilations than the declared budget."""
+
+
+class CompileLog:
+    """Mutable counter the listener writes into (exposed by the
+    context managers)."""
+
+    def __init__(self):
+        self.count = 0
+        self.seconds = []
+
+    @property
+    def total_seconds(self):
+        return sum(self.seconds)
+
+    def __repr__(self):
+        return (f"CompileLog(count={self.count}, "
+                f"total_seconds={self.total_seconds:.3f})")
+
+
+# ONE process-wide listener dispatching to the currently-active logs:
+# jax's public monitoring API has no unregister, so per-use listeners
+# would accumulate forever in a long-running process (one sentinel
+# scope per sweep iteration is the advertised pattern).  The single
+# listener costs a string compare per event when no scope is active.
+_ACTIVE_LOGS: list = []
+_registered = False
+
+
+def _listener(event, duration_secs, **kwargs):
+    if event == COMPILE_EVENT:
+        for log in _ACTIVE_LOGS:
+            log.count += 1
+            log.seconds.append(duration_secs)
+
+
+@contextlib.contextmanager
+def count_compilations():
+    """Context manager yielding a :class:`CompileLog` that counts every
+    XLA backend compilation inside the block (nesting-safe)."""
+    import jax.monitoring
+
+    global _registered
+    if not _registered:
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        _registered = True
+    log = CompileLog()
+    _ACTIVE_LOGS.append(log)
+    try:
+        yield log
+    finally:
+        _ACTIVE_LOGS.remove(log)
+
+
+@contextlib.contextmanager
+def assert_compile_budget(budget=0, what="this block"):
+    """Assert at most ``budget`` backend compilations happen inside the
+    block (default 0: the steady-state invariant — a second identical
+    driver/sweep run must be compile-free)."""
+    with count_compilations() as log:
+        yield log
+    if log.count > budget:
+        raise RecompilationError(
+            f"{log.count} backend compilation(s) in {what} "
+            f"(budget {budget}, {log.total_seconds:.2f}s of XLA work) — "
+            "a shape/config/closure is varying between calls that "
+            "should hit the jit cache")

@@ -220,7 +220,7 @@ def _qtf_model_grid(qtf_data, w):
     qtf = qtf_data["qtf"]  # (nw2, nw2, nh, 6)
     nh, ndof = qtf.shape[2], qtf.shape[3]
     pts = np.stack(np.meshgrid(w, w, indexing="ij"), axis=-1).reshape(-1, 2)
-    Qm = np.zeros((nh, nw, nw, ndof), dtype=complex)
+    Qm = np.zeros((nh, nw, nw, ndof), dtype=np.complex128)
     for ih in range(nh):
         for idof in range(ndof):
             Qr = RegularGridInterpolator((w2, w2), qtf[:, :, ih, idof].real,

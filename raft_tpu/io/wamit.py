@@ -53,7 +53,7 @@ def read_wamit3(path):
     w = np.where(T < 0, 0.0, np.where(T == 0, np.inf, 2 * np.pi / np.where(T == 0, 1, T)))
     freqs = np.unique(w)
     heads = np.unique(data[:, 1])
-    X = np.zeros((len(heads), 6, len(freqs)), dtype=complex)
+    X = np.zeros((len(heads), 6, len(freqs)), dtype=np.complex128)
     fi = {f: n for n, f in enumerate(freqs)}
     hi = {h: n for n, h in enumerate(heads)}
     for row, wi in zip(data, w):
@@ -116,7 +116,7 @@ def read_rao_4(path):
     freqs = np.unique(w_all)
     heads = np.unique(data[:, 1])
     ndof = int(np.max(data[:, 2]))
-    Xi = np.zeros((len(heads), ndof, len(freqs)), dtype=complex)
+    Xi = np.zeros((len(heads), ndof, len(freqs)), dtype=np.complex128)
     fi = {f: n for n, f in enumerate(freqs)}
     hi = {h: n for n, h in enumerate(heads)}
     for row, wi in zip(data, w_all):
@@ -193,7 +193,7 @@ def load_bem_coefficients(hydro_path, w_model, rho, g, r_ref=None):
     out = dict(
         A_BEM=np.zeros((6, 6, nw)),
         B_BEM=np.zeros((6, 6, nw)),
-        X_BEM=np.zeros((1, 6, nw), dtype=complex),
+        X_BEM=np.zeros((1, 6, nw), dtype=np.complex128),
         headings=np.array([0.0]),
     )
 

@@ -38,13 +38,12 @@ TPU-first design:
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 
 from raft_tpu.ops import linsolve
 from raft_tpu.physics import morison
+from raft_tpu.utils import config
 from raft_tpu.utils.dtypes import compute_dtypes
 
 
@@ -72,10 +71,7 @@ def fixed_point_mode():
     profit (measured: while 1.08x vs the static scan's 0.55x on
     early-converging sea states), 'scan' on accelerators, where static
     trip counts compile to better-scheduled loop nests."""
-    mode = os.environ.get("RAFT_TPU_FIXED_POINT", "auto").strip().lower()
-    if mode not in ("auto", "scan", "while"):
-        raise ValueError(f"RAFT_TPU_FIXED_POINT={mode!r}: "
-                         "expected 'auto', 'scan' or 'while'")
+    mode = config.get("FIXED_POINT")
     if mode == "auto":
         mode = "while" if jax.default_backend() == "cpu" else "scan"
     return mode
@@ -176,8 +172,7 @@ def solve_dynamics_fowt(
         # still EVALUATES the update — the masking buys bit-compat, not
         # zero cost — so blocks are clamped to the cap and the outer
         # early-exit check bounds the waste to chunk-1 trips.
-        chunk = min(max(1, int(os.environ.get("RAFT_TPU_SCAN_CHUNK", "4"))),
-                    cap)
+        chunk = min(max(1, config.get("SCAN_CHUNK")), cap)
 
         def block(carry, it0):
             def body(c, j):
@@ -185,9 +180,12 @@ def solve_dynamics_fowt(
                 it = it0 + j
                 XiNext, done = step(XiLast, it)
                 # float counter: custom_root's JVP rule cannot produce
-                # the float0 tangent an int aux output would need
+                # the float0 tangent an int aux output would need (rdt
+                # literals: weak python floats are f64 under x64, which
+                # would put a 64-bit select in every masked trip)
                 n_real = n_real + jnp.where(done_prev | (it >= cap),
-                                            0.0, 1.0)
+                                            jnp.asarray(0.0, dtype=rdt),
+                                            jnp.asarray(1.0, dtype=rdt))
                 return (XiNext, done_prev | done, n_real), None
 
             # full unroll: each block lowers to straight-line code (no

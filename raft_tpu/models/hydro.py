@@ -15,6 +15,7 @@ from raft_tpu.ops import waves as wv
 from raft_tpu.physics import morison
 from raft_tpu.physics.statics import platform_kinematics, node_T
 from raft_tpu.structure.schema import coerce
+from raft_tpu.utils.dtypes import compute_dtypes
 
 
 def make_sea_state(case, w):
@@ -138,7 +139,7 @@ class FOWTHydro:
         self.S, self.zeta, self.beta = S, zeta, beta
         out = morison.hydro_excitation(
             self.fs, self.strips, self.hc,
-            jnp.asarray(zeta, dtype=complex), jnp.asarray(beta),
+            jnp.asarray(zeta).astype(compute_dtypes(zeta)[1]), jnp.asarray(beta),
             jnp.asarray(self.w), jnp.asarray(self.k), self.Tn, self.r_nodes,
         )
         self.u = out["u"]
@@ -155,7 +156,7 @@ class FOWTHydro:
             I6 = jnp.asarray(rot.hydro["I_hydro"])
             for ih in range(len(beta)):
                 _, ud, _ = wv.wave_kinematics(
-                    jnp.asarray(zeta[ih], dtype=complex)[None, :],
+                    jnp.asarray(zeta[ih]).astype(compute_dtypes(zeta)[1])[None, :],
                     float(beta[ih]), jnp.asarray(self.w), jnp.asarray(self.k),
                     fs.depth, r_hub, rho=fs.rho_water, g=fs.g)
                 ud = ud.reshape(3, -1)  # (3, nw)

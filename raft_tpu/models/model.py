@@ -337,7 +337,7 @@ class Model:
             f_aero0=np.zeros((nDOF, max(fs.nrotors, 1))),
             A_aero=np.zeros((nDOF, nDOF, nw)),
             B_aero=np.zeros((nDOF, nDOF, nw)),
-            f_aero=np.zeros((nDOF, nw), dtype=complex),
+            f_aero=np.zeros((nDOF, nw), dtype=np.complex128),
             B_gyro=np.zeros((nDOF, nDOF)),
             A00=np.zeros((nw, max(fs.nrotors, 1))),
             B00=np.zeros((nw, max(fs.nrotors, 1))),
@@ -657,8 +657,9 @@ class Model:
         settings = self.design.get("settings", {}) or {}
         name = str(self.design.get("name", "design")).replace(" ", "_")[:40]
         if save_dir is None:
-            save_dir = os.environ.get(
-                "RAFT_TPU_BEM_DIR", os.path.join(os.getcwd(), "_bem_cache"))
+            from raft_tpu.utils import config
+
+            save_dir = config.get("BEM_DIR")
         os.makedirs(save_dir, exist_ok=True)
 
         if w_bem is None:
@@ -740,7 +741,7 @@ class Model:
         fs = self.fowtList[ifowt]
         nDOF, nw = fs.nDOF, self.nw
         nWaves = 1 if np.isscalar(case.get("wave_heading", 0)) else len(case["wave_heading"])
-        F = np.zeros((nWaves, nDOF, nw), dtype=complex)
+        F = np.zeros((nWaves, nDOF, nw), dtype=np.complex128)
         bem = self.bem_list[ifowt]
         if bem is not None and np.any(np.abs(bem["X_BEM"]) > 0):
             S, zeta, beta = make_sea_state(case, self.w)

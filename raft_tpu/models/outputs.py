@@ -180,7 +180,7 @@ def turbine_outputs(model, case, X0, Xi, S, zeta, A_aero=None, B_aero=None,
         T_mean = mooring_tension_vector(ms, X0[:6])
         nL = ms.n_lines
         nWp1 = Xi.shape[0]
-        T_amps = np.zeros((nWp1, 2 * nL, model.nw), dtype=complex)
+        T_amps = np.zeros((nWp1, 2 * nL, model.nw), dtype=np.complex128)
         beta = np.atleast_1d(np.deg2rad(np.asarray(
             case.get("wave_heading", 0.0), dtype=float)))
         S_arr = np.atleast_2d(np.asarray(S))

@@ -192,7 +192,7 @@ def solve_bem(vertices, centroids, normals, areas, omegas,
     heads = np.deg2rad(np.asarray(headings_deg, dtype=float))
     A = np.zeros((6, 6, len(omegas)))
     B = np.zeros((6, 6, len(omegas)))
-    X = np.zeros((nh, 6, len(omegas)), dtype=complex)
+    X = np.zeros((nh, 6, len(omegas)), dtype=np.complex128)
 
     # table built once up front (not thread-safe lazily)
     from raft_tpu.native.green_table import build_tables

@@ -37,10 +37,10 @@ models are solver-flag independent.
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
+
+from raft_tpu.utils import config
 
 # beyond this the O(N^3) unrolled elimination stops paying for itself
 # (and pivot-free growth becomes a real concern) — generic LU takes over
@@ -53,10 +53,7 @@ def solver_path(n=None):
     Returns ``'native'`` or ``'lapack'``; raises on an unknown
     ``RAFT_TPU_SOLVER`` value so typos fail loudly, not silently slow.
     """
-    mode = os.environ.get("RAFT_TPU_SOLVER", "native").strip().lower()
-    if mode not in ("native", "lapack"):
-        raise ValueError(
-            f"RAFT_TPU_SOLVER={mode!r}: expected 'native' or 'lapack'")
+    mode = config.get("SOLVER")
     if n is not None and n > MAX_NATIVE_N:
         return "lapack"
     return mode

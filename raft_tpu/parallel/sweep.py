@@ -25,6 +25,54 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from raft_tpu.utils.dtypes import compute_dtypes
+
+def _mesh_key(mesh):
+    return (tuple(mesh.axis_names), mesh.devices.shape,
+            tuple(d.id for d in mesh.devices.flat))
+
+
+def _flags_key():
+    """Trace-time RAFT_TPU_* flag values that shape the compiled
+    program.  Part of every memo key: the registry promises flags are
+    re-read per call, so a sweep after a flag flip must re-trace
+    instead of silently reusing the old-flag program."""
+    from raft_tpu.utils import config
+
+    return tuple(config.get(k) for k in
+                 ("SOLVER", "FIXED_POINT", "SCAN_CHUNK", "DTYPE"))
+
+
+def _cached_jit(evaluate, key, build):
+    """The jitted wrapper for (evaluate, key), built at most once.
+
+    `jax.jit(vmap(...))` built inside the sweep call would be a FRESH
+    function object every invocation, so a second identical sweep
+    re-traced and re-compiled the whole batched program (observed by
+    the recompilation sentinel, raft_tpu.analysis.recompile).  The memo
+    lives in the evaluator's own attribute dict — the wrapper closes
+    over the evaluator, so the two form a plain reference cycle the gc
+    reclaims together once the caller drops the evaluator (a
+    module-level cache keyed on the evaluator would pin its closed-over
+    model build tensors for process lifetime).
+
+    Trace-once contract: an evaluator is traced at most once per
+    (out_keys, mesh, trace-time flags) key — closed-over state mutated
+    AFTER the first sweep is not picked up (build a fresh evaluator, or
+    ``del evaluate._raft_sweep_jit`` to force a re-trace)."""
+    if getattr(evaluate, "__self__", None) is not None:
+        # bound method: its attribute dict is the CLASS function's,
+        # shared by every instance — memoizing there would hand
+        # instance B a program compiled over instance A's state
+        return build()
+    try:
+        per = evaluate.__dict__.setdefault("_raft_sweep_jit", {})
+    except AttributeError:  # no attribute dict: no memoization
+        return build()
+    if key not in per:
+        per[key] = build()
+    return per[key]
+
 
 def make_mesh(n_devices=None, axis_names=("dp",)):
     devices = np.array(jax.devices()[: n_devices or len(jax.devices())])
@@ -61,9 +109,16 @@ def sweep_cases(evaluate, Hs, Tp, beta, mesh=None, out_keys=("PSD", "X0")):
     if mesh is None:
         mesh = make_mesh()
     _check_dp_divisible(len(np.asarray(Hs)), mesh)
-    batched = jax.vmap(lambda h, t, b: {k: evaluate(h, t, b)[k] for k in out_keys})
     sharding = NamedSharding(mesh, P("dp"))
-    fn = jax.jit(batched, in_shardings=(sharding, sharding, sharding))
+
+    def build():
+        batched = jax.vmap(
+            lambda h, t, b: {k: evaluate(h, t, b)[k] for k in out_keys})
+        return jax.jit(batched,
+                       in_shardings=(sharding, sharding, sharding))
+
+    fn = _cached_jit(evaluate, ("cases", tuple(out_keys), _mesh_key(mesh),
+                                _flags_key()), build)
     args = [jax.device_put(jnp.asarray(x), sharding) for x in (Hs, Tp, beta)]
     return fn(*args)
 
@@ -98,7 +153,6 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
             f"ragged case dict: all case arrays must have equal length, "
             f"got {lengths}")
     _check_dp_divisible(next(iter(lengths.values())), mesh)
-    batched = jax.vmap(lambda c: {k: evaluate(c)[k] for k in out_keys})
     in_sh = jax.tree_util.tree_map(
         lambda _: NamedSharding(mesh, P("dp")), cases)
 
@@ -109,8 +163,14 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
             return NamedSharding(mesh, P("dp", *([None] * (nfree - 1)), "sp"))
         return NamedSharding(mesh, P("dp"))
 
-    out_sh = {k: out_spec(k) for k in out_keys}
-    fn = jax.jit(batched, in_shardings=(in_sh,), out_shardings=out_sh)
+    def build():
+        batched = jax.vmap(lambda c: {k: evaluate(c)[k] for k in out_keys})
+        out_sh = {k: out_spec(k) for k in out_keys}
+        return jax.jit(batched, in_shardings=(in_sh,), out_shardings=out_sh)
+
+    fn = _cached_jit(
+        evaluate, ("cases_full", tuple(out_keys), tuple(sorted(cases)),
+                   bool(shard_freq), _mesh_key(mesh), _flags_key()), build)
     args = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(jnp.asarray(x), s), dict(cases), in_sh)
     return fn(args)
@@ -196,8 +256,8 @@ def qtf_slender_sharded(model, waveHeadInd=0, Xi0=None, ifowt=0, mesh=None):
     flat_spec = NamedSharding(mesh, P(mesh.axis_names))
 
     if Xi0 is None:
-        Xi0 = np.zeros((nDOF, model.nw), dtype=complex)
-    Xi = np.zeros((nDOF, nw2), dtype=complex)
+        Xi0 = np.zeros((nDOF, model.nw), dtype=np.complex128)
+    Xi = np.zeros((nDOF, nw2), dtype=np.complex128)
     for i in range(nDOF):
         Xi[i] = np.interp(w2nd, model.w, Xi0[i], left=0, right=0)
 
@@ -217,7 +277,7 @@ def qtf_slender_sharded(model, waveHeadInd=0, Xi0=None, ifowt=0, mesh=None):
         ofs += mem.ns
 
     def all_members(i1_, i2_):
-        F = jnp.zeros((i1_.shape[0], 6), dtype=complex)
+        F = jnp.zeros((i1_.shape[0], 6), dtype=compute_dtypes()[1])
         for mem, a_i_m in members:
             F = F + member_qtf(mem, a_i_m, Xi[:6], beta, w2nd, k2nd,
                                fs.depth, fs.rho_water, fs.g,
@@ -228,7 +288,7 @@ def qtf_slender_sharded(model, waveHeadInd=0, Xi0=None, ifowt=0, mesh=None):
                  out_shardings=flat_spec)
     Fpairs = np.asarray(fn(i1, i2))[:npairs]
 
-    qtf = np.zeros((nw2, nw2, 1, nDOF), dtype=complex)
+    qtf = np.zeros((nw2, nw2, 1, nDOF), dtype=np.complex128)
     qtf[idx1, idx2, 0, :6] = Fpairs
 
     # Pinkster IV rotation term: one blocked broadcast, not an

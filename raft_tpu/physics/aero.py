@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.structure.schema import coerce
+from raft_tpu.utils.dtypes import compute_dtypes
 
 RAD2DEG = 57.29577951308232
 RPM2RADPS = 0.1047  # reference's conversion constant (helpers.py:30-33)
@@ -565,14 +566,14 @@ def calc_aero(rot: RotorAeroModel, rprops, case, w, speed=None,
     turbulence = case.get("current_turbulence", 0.0) if current else case.get("turbulence", 0.0)
     hubHt = rprops.Zhub
     S_rot = kaimal_rot_psd(w, speed, turbulence, hubHt, rot.Rtip)
-    V_w = np.sqrt(2 * S_rot * (w[1] - w[0])).astype(complex)
+    V_w = np.sqrt(2 * S_rot * (w[1] - w[0])).astype(np.complex128)
 
     a = np.zeros((6, 6, nw))
     b = np.zeros((6, 6, nw))
-    f = np.zeros((6, nw), dtype=complex)
+    f = np.zeros((6, nw), dtype=np.complex128)
     # rotor-channel transfer-function data (raft_rotor.py:926-947,
     # consumed by saveTurbineOutputs raft_fowt.py:2630-2688)
-    chan = dict(C=np.zeros(nw, dtype=complex), kp_beta=0.0, ki_beta=0.0,
+    chan = dict(C=np.zeros(nw, dtype=np.complex128), kp_beta=0.0, ki_beta=0.0,
                 kp_tau=0.0, ki_tau=0.0,
                 aero_torque=float(loads[3]),
                 aero_power=float(loads[3] * Om * 2 * np.pi / 60.0))
@@ -580,7 +581,7 @@ def calc_aero(rot: RotorAeroModel, rprops, case, w, speed=None,
     if rprops.aeroServoMod == 1:
         b_in = np.zeros((6, 6, nw))
         b_in[0, 0, :] = dT_dU
-        f_in = np.zeros((6, nw), dtype=complex)
+        f_in = np.zeros((6, nw), dtype=np.complex128)
         f_in[0, :] = dT_dU * V_w
         for iw in range(nw):
             b[:, :, iw] = np.asarray(tf.rotate_matrix_6(b_in[:, :, iw], R_q))
@@ -903,7 +904,8 @@ def calc_aero_traced(rot: RotorAeroModel, rprops, w, speed, heading_rad,
     TurbMod, V_ref_cls = turb_static
     S_rot = kaimal_rot_psd_traced(w, speed, TI, rprops.Zhub, rot.Rtip,
                                   TurbMod=TurbMod, V_ref_cls=V_ref_cls)
-    V_w = jnp.sqrt(2 * S_rot * dw).astype(complex)
+    cdt = compute_dtypes(S_rot, w)[1]
+    V_w = jnp.sqrt(2 * S_rot * dw).astype(cdt)
 
     # hub-frame coefficients reduce to the thrust-axis outer product
     qq = jnp.outer(q, q)  # (3,3)
@@ -930,11 +932,11 @@ def calc_aero_traced(rot: RotorAeroModel, rprops, w, speed, heading_rad,
     else:
         a2 = jnp.zeros(nw)
         b2 = jnp.zeros(nw)
-        f2 = jnp.zeros(nw, dtype=complex)
+        f2 = jnp.zeros(nw, dtype=cdt)
 
     a6 = jnp.zeros((nw, 6, 6)).at[:, :3, :3].set(a2[:, None, None] * qq)
     b6 = jnp.zeros((nw, 6, 6)).at[:, :3, :3].set(b2[:, None, None] * qq)
-    f6 = jnp.zeros((nw, 6), dtype=complex).at[:, :3].set(f2[:, None] * q)
+    f6 = jnp.zeros((nw, 6), dtype=cdt).at[:, :3].set(f2[:, None] * q)
 
     # shift from hub to the rotor node (raft_rotor.py:1021-1026)
     r_off = q * rprops.overhang

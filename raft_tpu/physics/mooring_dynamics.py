@@ -34,6 +34,7 @@ import numpy as np
 
 from raft_tpu.ops import waves as wv
 from raft_tpu.physics.mooring import solve_catenary
+from raft_tpu.utils.dtypes import compute_dtypes
 
 
 def line_static_shape(r_anchor, r_fair, L, w_lin, EA, n_seg=24,
@@ -204,14 +205,16 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
             clamp[3 * i + 2] = True
 
     # ---- wave kinematics at the nodes
-    zeta = jnp.asarray(zeta, dtype=complex)
+    # complex width follows the inputs (f32 pipelines stay complex64)
+    cdt = compute_dtypes(w_arr, zeta)[1]
+    zeta = jnp.asarray(zeta).astype(cdt)
     u, ud, _ = wv.wave_kinematics(
         zeta[None, :], beta, w_arr, jnp.asarray(k_arr), depth,
         jnp.asarray(r_nodes), rho=rho, g=g)   # (n+1, 3, nw)
 
     # end-motion amplitudes
-    XA = jnp.zeros((3, nw), dtype=complex) if RAO_A is None else jnp.asarray(RAO_A)
-    XB = jnp.zeros((3, nw), dtype=complex) if RAO_B is None else jnp.asarray(RAO_B)
+    XA = jnp.zeros((3, nw), dtype=cdt) if RAO_A is None else jnp.asarray(RAO_A)
+    XB = jnp.zeros((3, nw), dtype=cdt) if RAO_B is None else jnp.asarray(RAO_B)
 
     K_j = jnp.asarray(K)
     M_j = jnp.asarray(M)
@@ -249,7 +252,8 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
              - iwc * jnp.einsum("ij,jw->wi", C_A_j, XA)
              - iwc * jnp.einsum("ij,jw->wi", C_B_j, XB))
         D = (K_j[None] + 1j * w_arr[:, None, None] * (Bfull + C_j)[None]
-             - (w_arr**2)[:, None, None] * M_j[None]).astype(complex)
+             - (w_arr**2)[:, None, None] * M_j[None])
+        D = D.astype(cdt)
         # clamped dofs: identity rows/cols, zero rhs
         idx = jnp.where(clamp_j, 1.0, 0.0)
         D = D * (1 - idx[None, :, None]) * (1 - idx[None, None, :])
@@ -291,7 +295,8 @@ def line_dynamics(r_nodes, T_nodes, grounded, L, EA, m_lin, d_vol,
     # end-B motion with the interior dynamically condensed out
     Bfull = block_diag(Bn)
     D = (K_j[None] + 1j * w_arr[:, None, None] * (Bfull + C_j)[None]
-         - (w_arr**2)[:, None, None] * M_j[None]).astype(complex)
+         - (w_arr**2)[:, None, None] * M_j[None])
+    D = D.astype(cdt)
     idx = jnp.where(clamp_j, 1.0, 0.0)
     D = D * (1 - idx[None, :, None]) * (1 - idx[None, None, :])
     D = D + jnp.eye(3 * n_int)[None] * idx[None, :]
@@ -324,8 +329,8 @@ def fowt_line_tension_amps(ms, r6, Xi_PRP, w_arr, k_arr, S, beta, depth,
     nw = len(w_np)
     nL = ms.n_lines
     dw = w_np[1] - w_np[0]
-    zeta = np.sqrt(2 * np.asarray(S) * dw).astype(complex)
-    out = np.zeros((2 * nL, nw), dtype=complex)
+    zeta = np.sqrt(2 * np.asarray(S) * dw).astype(np.complex128)
+    out = np.zeros((2 * nL, nw), dtype=np.complex128)
 
     R = np.asarray(rotation_matrix(r6[3], r6[4], r6[5]))
     Xi_j = jnp.asarray(Xi_PRP)
@@ -362,9 +367,9 @@ def fowt_mooring_impedance(ms, r6, w_arr, k_arr, S, beta, depth,
     w_np = np.asarray(w_arr)
     nw = len(w_np)
     dw = w_np[1] - w_np[0]
-    zeta = np.sqrt(2 * np.asarray(S) * dw).astype(complex)
+    zeta = np.sqrt(2 * np.asarray(S) * dw).astype(np.complex128)
     R = np.asarray(rotation_matrix(r6[3], r6[4], r6[5]))
-    Z = jnp.zeros((nw, 6, 6), dtype=complex)
+    Z = jnp.zeros((nw, 6, 6), dtype=compute_dtypes()[1])
     for il in range(ms.n_lines):
         r_fair = np.asarray(r6[:3]) + R @ np.asarray(ms.r_fair0[il])
         r_nodes, T_nodes, grounded, s_arc = line_static_shape(

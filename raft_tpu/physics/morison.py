@@ -179,7 +179,7 @@ def build_strips(fs, k_array=None):
                 mcf_rows.append((np.nan_to_num(Cm_p1), np.nan_to_num(Cm_p2)))
             else:
                 mcf_rows.append(
-                    (np.full(nw, Cm0_p1, dtype=complex), np.full(nw, Cm0_p2, dtype=complex))
+                    (np.full(nw, Cm0_p1, dtype=np.complex128), np.full(nw, Cm0_p2, dtype=np.complex128))
                 )
 
     out = {k2: np.asarray(v) for k2, v in cols.items()}

@@ -27,6 +27,7 @@ import numpy as np
 from raft_tpu.ops import transforms as tf
 from raft_tpu.ops import waves as wv
 from raft_tpu.ops import waves2
+from raft_tpu.utils.dtypes import compute_dtypes
 
 
 def member_qtf(mem, a_i_member, Xi, beta, w2nd, k2nd, depth, rho, g,
@@ -47,14 +48,18 @@ def member_qtf(mem, a_i_member, Xi, beta, w2nd, k2nd, depth, rho, g,
     ns = mem.ns
     w2nd = jnp.asarray(w2nd)
     k2nd = jnp.asarray(k2nd)
-    Xi = jnp.asarray(Xi, dtype=complex)
+    # complex width follows the inputs (f32 sweeps stay complex64;
+    # the f64 parity path stays complex128) instead of the bare
+    # `complex` literal that pinned complex128 under x64
+    cdt = compute_dtypes(w2nd, Xi)[1]
+    Xi = jnp.asarray(Xi).astype(cdt)
 
     rA = jnp.asarray(mem.rA0)
     rB = jnp.asarray(mem.rB0)
     if mem.rA0[2] > 0 and mem.rB0[2] > 0:
         if pair_idx is not None:
-            return jnp.zeros((len(pair_idx[0]), 6), dtype=complex)
-        return jnp.zeros((nw2, nw2, 6), dtype=complex)
+            return jnp.zeros((len(pair_idx[0]), 6), dtype=cdt)
+        return jnp.zeros((nw2, nw2, 6), dtype=cdt)
 
     q = jnp.asarray(mem.q0)
     p1 = jnp.asarray(mem.p10)
@@ -95,7 +100,7 @@ def member_qtf(mem, a_i_member, Xi, beta, w2nd, k2nd, depth, rho, g,
     Xi_b = jnp.broadcast_to(Xi[None, :, :], (ns, 6, nw2))
     dr_n, nodeV, _ = wv.get_kinematics(r_j, Xi_b, w2nd)        # (ns, 3, nw2)
     u_n, _, _ = wv.wave_kinematics(
-        jnp.ones(nw2, dtype=complex), beta, w2nd, k2nd, depth, r_j, rho=rho, g=g)
+        jnp.ones(nw2, dtype=cdt), beta, w2nd, k2nd, depth, r_j, rho=rho, g=g)
 
     grad_u = jax.vmap(
         lambda rr: jax.vmap(lambda w_, k_: waves2.grad_u1(w_, k_, beta, depth, rr))(w2nd, k2nd)
@@ -112,7 +117,7 @@ def member_qtf(mem, a_i_member, Xi, beta, w2nd, k2nd, depth, rho, g,
         fr = (0.0 - r[0, 2]) / (r[-1, 2] - r[0, 2])
         r_int = jnp.asarray(r[0] + (r[-1] - r[0]) * fr)
         u_wl, ud_wl, eta = wv.wave_kinematics(
-            jnp.ones(nw2, dtype=complex), beta, w2nd, k2nd, depth, r_int, rho=1.0, g=1.0)
+            jnp.ones(nw2, dtype=cdt), beta, w2nd, k2nd, depth, r_int, rho=1.0, g=1.0)
         dr_wl, _, a_wl = wv.get_kinematics(r_int, Xi, w2nd)
         eta_r = eta - dr_wl[2, :]
         i_wl = int(np.where(r[:, 2] < 0)[0][-1])
@@ -128,9 +133,9 @@ def member_qtf(mem, a_i_member, Xi, beta, w2nd, k2nd, depth, rho, g,
             a_wl_area = d1 * d2
     else:
         r_int = jnp.zeros(3)
-        ud_wl = jnp.zeros((3, nw2), dtype=complex)
-        a_wl = jnp.zeros((3, nw2), dtype=complex)
-        eta_r = jnp.zeros(nw2, dtype=complex)
+        ud_wl = jnp.zeros((3, nw2), dtype=cdt)
+        a_wl = jnp.zeros((3, nw2), dtype=cdt)
+        eta_r = jnp.zeros(nw2, dtype=cdt)
         a_wl_area = 0.0
 
     # projected-gravity vector (raft_member.py:1529-1531)
@@ -251,7 +256,7 @@ def member_qtf(mem, a_i_member, Xi, beta, w2nd, k2nd, depth, rho, g,
         return jax.vmap(pair)(jnp.asarray(pair_idx[0]),
                               jnp.asarray(pair_idx[1]))
     Fpairs = jax.vmap(pair)(jnp.asarray(idx1), jnp.asarray(idx2))
-    qtf = jnp.zeros((nw2, nw2, 6), dtype=complex)
+    qtf = jnp.zeros((nw2, nw2, 6), dtype=cdt)
     qtf = qtf.at[idx1, idx2, :].set(Fpairs)
     return qtf
 
@@ -271,7 +276,7 @@ def kim_yue_correction(mem, beta, w2nd, k2nd, depth, rho, g, Nm=10):
     from scipy.special import hankel1
 
     nw2 = len(w2nd)
-    out = np.zeros((nw2, nw2, 6), dtype=complex)
+    out = np.zeros((nw2, nw2, 6), dtype=np.complex128)
     if not mem.MCF:
         return out
     if not (mem.rA0[2] * mem.rB0[2] < 0):
@@ -299,7 +304,7 @@ def kim_yue_correction(mem, beta, w2nd, k2nd, depth, rho, g, Nm=10):
             w1_, w2_ = w2nd[i1], w2nd[i2]
             k1_, k2_ = k2nd[i1], k2nd[i2]
             k1_k2 = np.array([k1_ * cosB - k2_ * cosB, k1_ * sinB - k2_ * sinB, 0.0])
-            F = np.zeros(6, dtype=complex)
+            F = np.zeros(6, dtype=np.complex128)
 
             # waterline term
             k1R, k2R = k1_ * R_wl, k2_ * R_wl
@@ -376,7 +381,7 @@ def pinkster_iv(Xi, F1st, block=512):
     Fl = np.asarray(F1st[:3]).T         # (nw2, 3)
     Fr_ = np.asarray(F1st[3:6]).T       # (nw2, 3)
     Xrc, Flc, Frc = np.conj(Xr), np.conj(Fl), np.conj(Fr_)
-    out = np.zeros((nw2, nw2, 6), dtype=complex)
+    out = np.zeros((nw2, nw2, 6), dtype=np.complex128)
     j = np.arange(nw2)
     for s in range(0, nw2, block):
         e = min(s + block, nw2)
@@ -406,12 +411,12 @@ def fowt_qtf_slender(model, waveHeadInd=0, Xi0=None, ifowt=0):
     beta = fh.beta[waveHeadInd]
 
     if Xi0 is None:
-        Xi0 = np.zeros((nDOF, model.nw), dtype=complex)
-    Xi = np.zeros((nDOF, nw2), dtype=complex)
+        Xi0 = np.zeros((nDOF, model.nw), dtype=np.complex128)
+    Xi = np.zeros((nDOF, nw2), dtype=np.complex128)
     for i in range(nDOF):
         Xi[i] = np.interp(w2nd, model.w, Xi0[i], left=0, right=0)
 
-    qtf = np.zeros((nw2, nw2, 1, nDOF), dtype=complex)
+    qtf = np.zeros((nw2, nw2, 1, nDOF), dtype=np.complex128)
 
     # Pinkster IV: rotation of first-order inertial forces (raft_fowt.py:2052-2061)
     F1st = np.asarray(stat["M_struc"]) @ (-(np.asarray(w2nd) ** 2) * Xi)

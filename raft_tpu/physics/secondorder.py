@@ -40,7 +40,7 @@ def read_qtf_12d(path, rho=1025.0, g=9.81, ulen=1.0, ndof=6):
     if not (w1 == w2).all():
         raise ValueError("both frequency columns must contain the same values")
 
-    qtf = np.zeros([len(w1), len(w2), len(heads), ndof], dtype=complex)
+    qtf = np.zeros([len(w1), len(w2), len(heads), ndof], dtype=np.complex128)
     for row in data:
         i1 = np.searchsorted(w1, row[0])
         i2 = np.searchsorted(w2, row[1])
@@ -119,7 +119,7 @@ def hydro_force_2nd(qtf_data, beta, S0, w):
         for imu in range(1, nw):
             Saux = np.zeros(nw)
             Saux[: nw - imu] = S0[imu:]
-            Qd = np.zeros(nw, dtype=complex)
+            Qd = np.zeros(nw, dtype=np.complex128)
             Qd[: nw - imu] = np.diag(Q, imu)
             f[idof, imu] = 4 * np.sqrt(np.sum(S0 * Saux * np.abs(Qd) ** 2)) * dw
         f_mean[idof] = 2 * np.sum(S0 * np.diag(Q.real)) * dw

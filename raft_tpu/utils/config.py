@@ -1,0 +1,201 @@
+"""Central registry for ``RAFT_TPU_*`` environment flags.
+
+Every knob the framework reads from the environment is declared here
+once, with a type, a default and a one-line description.  Call sites
+go through :func:`get` (typed, validated) or — for the few modules
+with bespoke parsing/caching semantics (dtype policy aliases, fault
+re-arming, log-sink swapping) — :func:`raw`, which is the only
+sanctioned way to read the raw string.
+
+Motivation: the flags accreted one ``os.environ.get`` at a time across
+the hot path, the sweep runtime and the bench; a typo'd name fails
+silently (the default quietly wins) and there was no single place to
+see what is tunable.  The registry makes unknown names loud
+(:class:`KeyError` at the call site, not a silent default), keeps
+parsing/validation in one place, and feeds the ``env-read`` rule of
+the trace-hygiene linter (:mod:`raft_tpu.analysis.lint`), which flags
+raw ``os.environ["RAFT_TPU_*"]`` reads anywhere else.
+
+Flags are *re-read from the environment on every call* — the hot path
+reads them at trace time (see e.g. :func:`raft_tpu.ops.linsolve.
+solver_path`), and tests monkeypatch them mid-process.  Nothing here
+imports jax, so the linter and CLI can load the registry without
+touching a backend.
+
+``python -m raft_tpu.analysis flags`` prints the full table.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+PREFIX = "RAFT_TPU_"
+
+
+@dataclass(frozen=True)
+class Flag:
+    """One registered environment flag (name is the ``RAFT_TPU_``-less
+    suffix; ``kind`` drives parsing in :func:`get`)."""
+
+    name: str
+    kind: str = "str"          # str | int | float | bool | choice | raw
+    default: object = None     # value when unset (or factory, below)
+    default_factory: object = None  # callable default (cwd-/home-relative)
+    choices: tuple = ()        # for kind == "choice" (values lowercased)
+    help: str = ""
+    aliases: dict = field(default_factory=dict)  # normalisation map
+
+    @property
+    def env(self):
+        return PREFIX + self.name
+
+
+FLAGS: dict[str, Flag] = {}
+
+
+def _register(*flags):
+    for f in flags:
+        FLAGS[f.name] = f
+
+
+def env_name(name):
+    """The full environment-variable name for a registered flag."""
+    return FLAGS[name].env
+
+
+def raw(name):
+    """Raw string value of a registered flag (None when unset).
+
+    For modules with bespoke parsing (dtype-policy aliases, fault-spec
+    lists, log sinks) — everything else should use :func:`get`.
+    Unknown names raise ``KeyError`` so typos fail loudly.
+    """
+    return os.environ.get(FLAGS[name].env)
+
+
+def get(name):
+    """Typed, validated value of a registered flag.
+
+    Re-reads the environment on every call (trace-time semantics).
+    Bad values raise ``ValueError`` naming the variable; unknown flag
+    names raise ``KeyError``.
+    """
+    f = FLAGS[name]
+    s = os.environ.get(f.env)
+    if s is None or (s == "" and f.kind != "str"):
+        if f.default_factory is not None:
+            return f.default_factory()
+        return f.default
+    if f.kind in ("str", "raw"):
+        return s
+    if f.kind == "int":
+        try:
+            return int(s)
+        except ValueError:
+            raise ValueError(f"{f.env}={s!r}: expected an integer")
+    if f.kind == "float":
+        try:
+            return float(s)
+        except ValueError:
+            raise ValueError(f"{f.env}={s!r}: expected a number")
+    if f.kind == "bool":
+        v = s.strip().lower()
+        if v in ("1", "true", "yes", "on"):
+            return True
+        if v in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"{f.env}={s!r}: expected a boolean (0/1)")
+    if f.kind == "choice":
+        v = s.strip().lower()
+        v = f.aliases.get(v, v)
+        if v not in f.choices:
+            raise ValueError(
+                f"{f.env}={s.strip().lower()!r}: expected one of "
+                + "/".join(repr(c) for c in f.choices if c))
+        return v
+    raise AssertionError(f"unhandled flag kind {f.kind!r}")
+
+
+def describe():
+    """Yield ``(env_name, kind, default, help)`` rows for every flag,
+    sorted by name (the ``flags`` CLI subcommand and the README table
+    render from this)."""
+    for f in sorted(FLAGS.values(), key=lambda f: f.name):
+        default = ("<dynamic>" if f.default_factory is not None
+                   else f.default)
+        yield f.env, f.kind, default, f.help
+
+
+# --------------------------------------------------------------- registry
+
+# accepted spellings of the two dtype policies — the single source of
+# truth for BOTH the env-var path (get("DTYPE")) and the explicit
+# policy argument of raft_tpu.utils.dtypes.compute_dtypes
+DTYPE_F32_NAMES = ("float32", "f32", "single", "complex64")
+DTYPE_F64_NAMES = ("float64", "f64", "double", "complex128")
+
+_F32_ALIASES = {a: "float32" for a in DTYPE_F32_NAMES}
+_F64_ALIASES = {a: "float64" for a in DTYPE_F64_NAMES}
+
+_register(
+    # -- hot-path compute policy
+    Flag("SOLVER", "choice", "native", choices=("native", "lapack"),
+         help="impedance-solve kernel: batched pivot-free native "
+              "elimination or jnp.linalg.solve (golden-parity fallback)"),
+    Flag("FIXED_POINT", "choice", "auto", choices=("auto", "scan", "while"),
+         help="drag-linearisation loop driver ('auto': while on CPU, "
+              "masked fixed-trip scan on accelerators)"),
+    Flag("SCAN_CHUNK", "int", 4,
+         help="masked-scan block size between early-exit checks"),
+    Flag("DTYPE", "choice", "", choices=("", "float32", "float64"),
+         aliases={**_F32_ALIASES, **_F64_ALIASES},
+         help="compute-dtype policy for the dynamics hot path "
+              "(default: derive from the inputs)"),
+    # -- runtime / caching
+    Flag("CACHE_DIR", "str",
+         default_factory=lambda: os.path.join(
+             os.path.expanduser("~"), ".cache", "raft_tpu", "jax_cache"),
+         help="persistent XLA compilation-cache directory"),
+    Flag("BEM_DIR", "str",
+         default_factory=lambda: os.path.join(os.getcwd(), "_bem_cache"),
+         help="panel-method BEM coefficient cache directory"),
+    Flag("PROBE_S", "float", 300.0,
+         help="accelerator health-probe timeout (seconds)"),
+    Flag("CLI_PLATFORM", "str", "cpu",
+         help="jax platform pin for `python -m raft_tpu` (cpu also "
+              "enables x64 for the parity path)"),
+    Flag("LOG", "raw", "",
+         help="structured-log sink: '-' for stderr, else a JSONL path"),
+    Flag("FAULTS", "raw", "",
+         help="deterministic fault injection: comma list of "
+              "kind:site[:count] specs (see raft_tpu.utils.faults)"),
+    Flag("PROFILE", "str", "",
+         help="when set, bench captures a jax profiler trace here"),
+    # -- bench harness
+    Flag("PEAK_TFLOPS", "float", 90.0,
+         help="assumed peak TF/s for the bench MFU estimate"),
+    Flag("BENCH_PLATFORM", "str", "",
+         help="jax platform pin for bench attempts (unset: ambient)"),
+    Flag("BENCH_MODE", "str", "",
+         help="bench child-process mode ('flat'/'geom'; internal)"),
+    Flag("BENCH_BUDGET_S", "float", 1350.0,
+         help="total bench wall-clock budget (seconds)"),
+    Flag("BENCH_DEADLINE_S", "float", None,
+         help="per-attempt deadline handed to bench children (internal)"),
+    Flag("BENCH_PROBE_S", "float", 300.0,
+         help="bench backend health-probe timeout (seconds)"),
+    Flag("BENCH_BREAKDOWN", "bool", True,
+         help="stage-attribution timing in the bench breakdown"),
+    Flag("BENCH_DESIGNS", "int", 16,
+         help="bench batch size (distinct design geometries)"),
+    Flag("BENCH_REPS", "int", 3,
+         help="bench steady-state timing repetitions"),
+    Flag("BENCH_NBASE", "int", 1,
+         help="cases measured for the serial NumPy baseline"),
+    Flag("BENCH_BASE_EVAL_S", "float", None,
+         help="pre-resolved NumPy-baseline seconds/design-eval "
+              "(internal, parent -> child)"),
+    Flag("BENCH_BASE_HOST", "str", "",
+         help="host fingerprint of the NumPy baseline (internal)"),
+)

@@ -12,9 +12,10 @@ caller places them.
 from __future__ import annotations
 
 import contextlib
-import os
 
 import jax
+
+from raft_tpu.utils import config
 
 
 @contextlib.contextmanager
@@ -64,10 +65,7 @@ def enable_compile_cache(cache_dir=None, platform=None,
     if platform:
         jax.config.update("jax_platforms", platform)
     if cache_dir is None:
-        cache_dir = os.environ.get(
-            "RAFT_TPU_CACHE_DIR",
-            os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu",
-                         "jax_cache"))
+        cache_dir = config.get("CACHE_DIR")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
@@ -94,6 +92,7 @@ def probe_backend(platform=None, timeout_s=None):
 
     Returns True when the backend answered, False on timeout/error.
     """
+    import os
     import subprocess
     import sys
 
@@ -102,7 +101,7 @@ def probe_backend(platform=None, timeout_s=None):
     if faults.take("unhealthy", "backend_probe"):
         return False
     if timeout_s is None:
-        timeout_s = float(os.environ.get("RAFT_TPU_PROBE_S", "300"))
+        timeout_s = config.get("PROBE_S")
     env = dict(os.environ)
     if platform:
         env["JAX_PLATFORMS"] = platform

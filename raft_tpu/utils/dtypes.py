@@ -22,26 +22,20 @@ evaluator whose precision you want to pin.
 
 from __future__ import annotations
 
-import os
-
 import jax.numpy as jnp
 
-_F32_NAMES = ("float32", "f32", "single", "complex64")
-_F64_NAMES = ("float64", "f64", "double", "complex128")
+from raft_tpu.utils import config
+
+# one alias table for both entry paths (env var and explicit policy)
+_F32_NAMES = config.DTYPE_F32_NAMES
+_F64_NAMES = config.DTYPE_F64_NAMES
 
 
 def policy_name():
     """The active policy string: '' (derive from inputs), 'float32' or
-    'float64'."""
-    p = os.environ.get("RAFT_TPU_DTYPE", "").strip().lower()
-    if not p:
-        return ""
-    if p in _F32_NAMES:
-        return "float32"
-    if p in _F64_NAMES:
-        return "float64"
-    raise ValueError(
-        f"RAFT_TPU_DTYPE={p!r}: expected 'float32', 'float64' or unset")
+    'float64' (the ``RAFT_TPU_DTYPE`` flag, alias-normalised and
+    validated by the :mod:`raft_tpu.utils.config` registry)."""
+    return config.get("DTYPE")
 
 
 def compute_dtypes(*arrays, policy=None):

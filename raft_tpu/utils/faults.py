@@ -45,6 +45,8 @@ from __future__ import annotations
 
 import os
 
+from raft_tpu.utils import config
+
 
 class InjectedFault(RuntimeError):
     """A non-transient injected failure (e.g. simulated crash mid-write)."""
@@ -77,7 +79,7 @@ def _parse(spec):
 def _sync_env():
     """(Re-)arm faults from RAFT_TPU_FAULTS whenever the var changes."""
     global _ENV_SEEN
-    raw = os.environ.get("RAFT_TPU_FAULTS", "")
+    raw = config.raw("FAULTS") or ""
     if raw == _ENV_SEEN:
         return
     _ENV_SEEN = raw

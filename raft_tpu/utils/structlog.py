@@ -18,9 +18,10 @@ from __future__ import annotations
 
 import atexit
 import json
-import os
 import sys
 import time
+
+from raft_tpu.utils import config
 
 _T0 = time.perf_counter()
 _SINK = None
@@ -33,7 +34,7 @@ def _sink():
     effect (file handles are swapped and closed at interpreter exit).
     The unset fast path is one dict lookup."""
     global _SINK, _DEST
-    dest = os.environ.get("RAFT_TPU_LOG", "")
+    dest = config.raw("LOG") or ""
     if dest != _DEST:
         if _SINK is not None and _SINK is not sys.stderr:
             try:

@@ -24,14 +24,15 @@ import time
 
 import numpy as np
 
+from raft_tpu.utils import config
+
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=10000)
     ap.add_argument("--shard", type=int, default=512)
     ap.add_argument("--out", default="_sweep10k")
-    ap.add_argument("--platform", default=os.environ.get(
-        "RAFT_TPU_BENCH_PLATFORM", ""))
+    ap.add_argument("--platform", default=config.get("BENCH_PLATFORM"))
     args = ap.parse_args()
 
     import jax

@@ -1,0 +1,25 @@
+"""Seeded violations for the host-coercion rule: host Python pulls of
+values that dataflow from jnp expressions."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def resid_to_python(x):
+    r = jnp.max(jnp.abs(x))
+    flag = bool(r < 1e-3)           # line 10: bool() on traced value
+    val = float(r)                  # line 11: float() on traced value
+    return flag, val
+
+
+def pull_to_numpy(x):
+    y = jnp.fft.rfft(x)
+    scalar = y.sum().item()         # line 17: .item() via tainted name
+    host = np.asarray(y)            # line 18: host pull mid-pipeline
+    return scalar, host
+
+
+def shape_access_is_fine(x):
+    y = jnp.abs(x)
+    n = int(y.shape[0])             # host metadata: NOT flagged
+    return n, len(np.asarray(y.shape))

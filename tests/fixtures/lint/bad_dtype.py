@@ -1,0 +1,18 @@
+"""Seeded violations for the dtype-literal rule (every flagged line is
+a real instance of the PR-2 silent-upcast bug class)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def traced_allocations(nw):
+    a = jnp.zeros(nw, dtype=complex)            # line 9: bare complex
+    b = jnp.ones((3, nw), dtype=jnp.complex128)  # line 10: pinned 64-bit
+    c = jnp.full(nw, 1.0, dtype="float64")       # line 11: string literal
+    d = a.astype(complex)                        # line 12: astype literal
+    e = jnp.zeros((3, nw), complex)              # line 13: positional dtype
+    return a, b, c, d, e
+
+
+def host_allocation(nw):
+    return np.zeros(nw, dtype=complex)           # line 18: ambiguous width

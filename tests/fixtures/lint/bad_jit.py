@@ -1,0 +1,18 @@
+"""Seeded violations for the jit-static rule: config-like parameters
+traced instead of declared static."""
+
+import jax
+import jax.numpy as jnp
+
+
+def evaluate(x, mode, n_iter):
+    y = jnp.sin(x)
+    for _ in range(3):
+        y = y + x
+    return y
+
+
+bad = jax.jit(lambda x, out_keys: x)                   # line 15: lambda
+bad_named = jax.jit(evaluate)                          # line 16: named def
+good = jax.jit(evaluate, static_argnames=("mode", "n_iter"))
+good_arrays = jax.jit(lambda x, y: x + y)

@@ -1,0 +1,19 @@
+"""Clean twins of bad_dtype.py: derived or explicitly-audited dtypes."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.utils.dtypes import compute_dtypes
+
+
+def traced_allocations(x, nw):
+    rdt, cdt = compute_dtypes(x)
+    a = jnp.zeros(nw, dtype=cdt)
+    b = jnp.ones((3, nw), dtype=cdt)
+    c = jnp.full(nw, 1.0, dtype=rdt)
+    return a, b, c, a.astype(cdt)
+
+
+def host_allocation(nw):
+    # explicit 64-bit width: audited host-side precision, not a leak
+    return np.zeros(nw, dtype=np.complex128)

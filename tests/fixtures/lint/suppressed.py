@@ -1,0 +1,20 @@
+"""Every violation here carries a suppression: the file must lint clean.
+
+# raft-lint: disable-file=env-read
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def audited(nw, x):
+    a = jnp.zeros(nw, dtype=complex)  # raft-lint: disable=dtype-literal
+    # raft-lint: disable=dtype-literal
+    b = np.zeros(nw, dtype=complex)
+    y = jnp.sum(x)
+    # raft-lint: disable=host-coercion
+    v = float(y)
+    flag = os.environ.get("RAFT_TPU_SOLVER")  # file-level suppression
+    return a, b, v, flag

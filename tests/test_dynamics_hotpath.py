@@ -90,46 +90,30 @@ def test_fixed_point_flag_validation(monkeypatch):
         dynamics.fixed_point_mode()
 
 
-def _count_primitive(jaxpr, name):
-    n = 0
-    for eqn in jaxpr.eqns:
-        n += eqn.primitive.name == name
-        for v in eqn.params.values():
-            vs = v if isinstance(v, (list, tuple)) else (v,)
-            for x in vs:
-                inner = getattr(x, "jaxpr", x)
-                if hasattr(inner, "eqns"):
-                    n += _count_primitive(inner, name)
-    return n
-
-
 def test_drag_iteration_jaxpr_gathers_no_geometry():
-    """Micro-regression guard for the loop-invariant hoisting: the only
-    gather the per-iteration closure may contain is of the
-    (iteration-dependent) node RESPONSE — geometry constants (strip
-    positions, lever arms, frames, areas) are gathered once in
+    """Regression guard for the loop-invariant hoisting, now expressed
+    through the shared contract engine (raft_tpu.analysis.
+    jaxpr_contracts): the declarative ``drag_lin_iter`` contract allows
+    at most ONE gather — the (iteration-dependent) node RESPONSE
+    lookup — and no dynamic_slice; geometry constants (strip positions,
+    lever arms, frames, areas) are gathered once in
     drag_lin_precompute.  Reintroducing an ``r_nodes[node_idx]``-style
     lookup into the iteration body fails this."""
-    model = raft_tpu.Model(SPAR)
-    fs = model.fowtList[0]
-    fh = model.hydro[0]
-    fh.hydro_excitation(SPAR_CASE)
-    pre = morison.drag_lin_precompute(
-        fs, fh.strips, fh.hc, fh.u[0], fh.Tn, fh.r_nodes,
-        jnp.asarray(model.w))
-    Xi0 = jnp.full((fs.nDOF, model.nw), 0.1, dtype=complex)
+    from raft_tpu.analysis import jaxpr_contracts as jc
 
-    it_jaxpr = jax.make_jaxpr(
-        lambda Xi: morison.drag_lin_iter(pre, Xi))(Xi0).jaxpr
-    assert _count_primitive(it_jaxpr, "gather") <= 1, str(it_jaxpr)
+    tracer = jc.EntryPointTracer(SPAR)
+    jaxpr = tracer.trace("drag_lin_iter", "float64")
+    assert jc.check_structure("drag_lin_iter", "float64", jaxpr) == []
 
     # sanity: the one-shot wrapper (precompute included) carries the
-    # geometry gathers — the bound above is not vacuous
-    full_jaxpr = jax.make_jaxpr(
+    # geometry gathers — the contract's gather cap is not vacuous
+    fs, fh, model = tracer.fs, tracer.fh, tracer.model
+    Xi0 = jnp.full((fs.nDOF, model.nw), 0.1 + 0j)
+    full = jax.make_jaxpr(
         lambda Xi: morison.hydro_linearization(
             fs, fh.strips, fh.hc, fh.u[0], Xi, jnp.asarray(model.w),
-            fh.Tn, fh.r_nodes))(Xi0).jaxpr
-    assert _count_primitive(full_jaxpr, "gather") >= 2
+            fh.Tn, fh.r_nodes))(Xi0)
+    assert jc.count_primitives(full)["gather"] >= 2
 
 
 def test_dtype_policy_float32_smoke(monkeypatch):

@@ -1,0 +1,97 @@
+"""Unit tests for the trace-hygiene AST linter
+(:mod:`raft_tpu.analysis.lint`): every rule on seeded good/bad fixture
+snippets, the suppression syntax, the CLI exit codes, and the CI gate
+itself (the repo must lint clean).
+
+Pure-AST: no jax import, no backend.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from raft_tpu.analysis import lint
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "fixtures", "lint")
+ALL_RULES = set(lint.RULES)
+
+
+def run_fixture(name):
+    return lint.lint_file(os.path.join(FIXTURES, name), rules=ALL_RULES)
+
+
+def rules_by_line(findings):
+    return {(f.line, f.rule) for f in findings}
+
+
+def test_bad_dtype_fixture():
+    found = run_fixture("bad_dtype.py")
+    assert {f.rule for f in found} == {"dtype-literal"}
+    assert {f.line for f in found} == {9, 10, 11, 12, 13, 18}
+
+
+def test_good_dtype_fixture_clean():
+    assert run_fixture("good_dtype.py") == []
+
+
+def test_bad_coercion_fixture():
+    found = run_fixture("bad_coercion.py")
+    assert {f.rule for f in found} == {"host-coercion"}
+    # shape/len metadata access must NOT be flagged (lines 24-25)
+    assert {f.line for f in found} == {10, 11, 17, 18}
+
+
+def test_bad_env_fixture():
+    found = run_fixture("bad_env.py")
+    assert {f.rule for f in found} == {"env-read"}
+    assert {f.line for f in found} == {8, 9, 10}
+
+
+def test_bad_jit_fixture():
+    found = run_fixture("bad_jit.py")
+    assert {f.rule for f in found} == {"jit-static"}
+    assert {f.line for f in found} == {15, 16}
+    assert any("out_keys" in f.message for f in found)
+    assert any("mode" in f.message for f in found)
+
+
+def test_suppressions_silence_findings():
+    assert run_fixture("suppressed.py") == []
+
+
+def test_finding_format_is_file_line_col():
+    f = run_fixture("bad_env.py")[0]
+    path, line, col, rest = f.format().split(":", 3)
+    assert path.endswith("bad_env.py")
+    assert int(line) == f.line and int(col) == f.col
+    assert "[env-read]" in rest
+
+
+def test_repo_lints_clean():
+    """The CI gate: the default scan set has zero findings."""
+    findings = lint.lint_paths()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_env_read_allowed_in_registry():
+    """The registry module itself is the sanctioned reader."""
+    cfg = os.path.join(lint.repo_root(), "raft_tpu", "utils", "config.py")
+    assert lint.lint_file(cfg) == []
+
+
+@pytest.mark.parametrize("args,expected", [
+    ([], 0),                                           # repo clean
+    ([os.path.join(FIXTURES, "bad_env.py")], 1),       # findings -> 1
+])
+def test_cli_exit_codes(args, expected):
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "lint", *args],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(HERE))
+    assert p.returncode == expected, p.stdout + p.stderr
+    if expected == 1:
+        # file:line findings on stdout
+        assert "bad_env.py:8" in p.stdout

@@ -1,0 +1,159 @@
+"""Tier-1 tests for the jaxpr contract engine and the recompilation
+sentinel (:mod:`raft_tpu.analysis`).
+
+* the declarative contracts + primitive-count baseline hold on the
+  bundled spar design under BOTH ``RAFT_TPU_DTYPE`` modes and BOTH
+  fixed-point drivers (trace-only — nothing is compiled or executed);
+* the contracts are non-vacuous: a seeded re-gather regression and a
+  seeded host callback are caught;
+* the recompilation sentinel counts real backend compiles, and a
+  second identical sweep invocation is compile-free (the steady-state
+  invariant reported by bench.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.analysis import jaxpr_contracts as jc
+from raft_tpu.analysis import recompile
+
+
+@pytest.fixture(scope="module")
+def tracer():
+    """One spar model build shared by every contract check."""
+    return jc.EntryPointTracer()
+
+
+def test_contracts_and_budgets_all_entries(tracer):
+    """The acceptance gate: every entry point, both dtype modes, both
+    fixed-point drivers — structural contracts AND the checked-in
+    primitive-count baseline."""
+    report = jc.run_checks(tracer=tracer)
+    assert report["violations"] == [], "\n".join(report["violations"])
+    # sanity: all four entries actually traced, in every variant
+    assert set(report["counts"]) == set(jc.CONTRACTS)
+    assert set(report["counts"]["solve_dynamics_fowt"]) == {
+        "float64+while", "float64+scan", "float32+while", "float32+scan"}
+
+
+def test_budget_catches_bloat(tracer):
+    """A grown jaxpr fails the budget with a primitive-level diff."""
+    jaxpr = tracer.trace("drag_lin_iter", "float64")
+    counts = jc.count_primitives(jaxpr)
+    counts["gather"] = counts.get("gather", 0) + 50   # simulated re-gather loop
+    counts["mul"] = counts.get("mul", 0) * 3          # simulated unroll bloat
+    viols = jc.check_budget("drag_lin_iter", "float64", counts,
+                            jc.load_baseline())
+    assert any("gather" in v for v in viols)
+
+
+def test_missing_baseline_entry_is_loud():
+    viols = jc.check_budget("drag_lin_iter", "float99", {"add": 1}, {})
+    assert viols and "baseline" in viols[0]
+
+
+def test_contract_catches_seeded_regather(tracer):
+    """Non-vacuous: an Xi-dependent geometry-style lookup added to the
+    iteration body violates the gather cap."""
+    from raft_tpu.physics import morison
+
+    model, fs, fh = tracer.model, tracer.fs, tracer.fh
+    pre = morison.drag_lin_precompute(
+        fs, fh.strips, fh.hc, fh.u[0], fh.Tn, fh.r_nodes,
+        jnp.asarray(model.w))
+    idx = jnp.arange(fs.nDOF)
+
+    def regressed_iter(Xi):
+        out = morison.drag_lin_iter(pre, Xi)
+        # the PR-2 bug class: re-gathering per iteration
+        return out["B_hydro_drag"] + Xi.real[idx, :].sum() * jnp.eye(fs.nDOF)
+
+    Xi0 = jnp.full((fs.nDOF, model.nw), 0.1 + 0j)
+    jaxpr = jax.make_jaxpr(regressed_iter)(Xi0)
+    viols = jc.check_structure("drag_lin_iter", "float64", jaxpr)
+    assert any("gather" in v for v in viols)
+
+
+def test_contract_catches_host_callback(tracer):
+    def leaky(x):
+        jax.debug.callback(lambda v: None, x[0])
+        return x * 2.0
+
+    jaxpr = jax.make_jaxpr(leaky)(jnp.zeros(4))
+    viols = jc.check_structure("system_response", "float64", jaxpr)
+    assert any("callback" in v for v in viols)
+
+
+def test_dtype64_leak_detected_in_loop_body():
+    """A float64 op inside a while body is caught by the loop-scoped
+    float32 contract (the build prefix stays exempt)."""
+    big = jnp.asarray(np.ones(4), dtype=jnp.float64)
+
+    def f(x):
+        staged = (big * 2.0).astype(jnp.float32)  # build prefix: allowed
+
+        def body(c):
+            return c + (big.sum() / 4.0).astype(jnp.float32)  # leak: f64 sum per trip
+
+        return jax.lax.while_loop(lambda c: c.sum() < 10.0, body,
+                                  x + staged)
+
+    jaxpr = jax.make_jaxpr(f)(jnp.zeros(4, jnp.float32))
+    hits = jc.produced_64bit_in_loops(jaxpr)
+    assert hits, "seeded f64 loop-body op not detected"
+    c = jc.CONTRACTS["solve_dynamics_fowt"]
+    assert c.dtype_clean == "loops"
+    viols = jc.check_structure("solve_dynamics_fowt", "float32+while", jaxpr)
+    assert any("64-bit" in v for v in viols)
+
+
+# ------------------------------------------------------- recompile sentinel
+
+def test_sentinel_counts_compiles():
+    f = jax.jit(lambda x: x * 2.0 + 1.0)
+    x3, x4 = jnp.ones(3), jnp.ones(4)
+    f(x3).block_until_ready()  # warm
+
+    with recompile.count_compilations() as log:
+        f(x3).block_until_ready()          # cache hit
+    assert log.count == 0
+
+    with recompile.count_compilations() as log:
+        f(x4).block_until_ready()          # new shape -> compile
+    assert log.count >= 1
+    assert log.total_seconds > 0
+
+    with pytest.raises(recompile.RecompilationError, match="budget 0"):
+        with recompile.assert_compile_budget(0):
+            jax.jit(lambda x: x - 5.0)(x3).block_until_ready()
+
+
+def test_second_identical_sweep_is_compile_free():
+    """The steady-state invariant on the real sweep driver: the jitted
+    batched program is memoized per evaluator, so a second identical
+    ``sweep_cases`` invocation triggers ZERO backend compilations."""
+    from raft_tpu.parallel.sweep import make_mesh, sweep_cases
+
+    def evaluate(h, t, b):
+        w = jnp.linspace(0.1, 2.0, 16)
+        psd = (h / t) ** 2 / ((w - 2 * np.pi / t) ** 2 + 0.01)
+        return {"PSD": psd, "X0": jnp.stack([h * jnp.cos(b),
+                                             h * jnp.sin(b)])}
+
+    mesh = make_mesh(8)
+    Hs = np.linspace(1.0, 8.0, 8)
+    Tp = np.linspace(6.0, 14.0, 8)
+    beta = np.zeros(8)
+
+    with recompile.count_compilations() as first:
+        out1 = sweep_cases(evaluate, Hs, Tp, beta, mesh=mesh)
+        jax.block_until_ready(out1)
+    assert first.count >= 1  # the warm run really compiled something
+
+    with recompile.assert_compile_budget(0, "second identical sweep"):
+        out2 = sweep_cases(evaluate, Hs, Tp, beta, mesh=mesh)
+        jax.block_until_ready(out2)
+    np.testing.assert_array_equal(np.asarray(out1["PSD"]),
+                                  np.asarray(out2["PSD"]))
