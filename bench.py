@@ -741,27 +741,45 @@ def _stage_times(jit_builder, args, reps, t_compile, dt, t_start):
         return None, None
 
 
-def _drag_iters(jit_raw_builder, args, t_compile, t_dyn, t_start):
-    """Realized drag-linearisation iteration counts across the batch
-    (the fixed point reports how many masked scan trips did real work).
-    One extra pruned compilation, so only taken when the deadline
-    leaves room after the stage breakdown."""
-    import numpy as np
-
+def _pruned_probe(jit_raw_builder, key, args, t_compile, t_dyn, t_start):
+    """Fetch one diagnostic output across the batch via a pipeline
+    pruned to ``key`` (XLA dead-code-eliminates everything downstream).
+    One extra compilation per probe, so only taken when the attempt
+    deadline leaves room after the stage breakdown; None when
+    skipped/failed."""
     remaining = _deadline_remaining(t_start)
     if t_dyn is None or (remaining is not None
                          and remaining < 1.3 * max(t_compile, 5.0)):
         return None
     try:
-        it = np.asarray(jit_raw_builder("n_iter_drag")(*args))
-        return it
+        return np.asarray(jit_raw_builder(key)(*args))
     except Exception:
         return None
 
 
+def _drag_iters(jit_raw_builder, args, t_compile, t_dyn, t_start):
+    """Realized drag-linearisation iteration counts across the batch
+    (the fixed point reports how many masked scan trips did real work)."""
+    return _pruned_probe(jit_raw_builder, "n_iter_drag", args,
+                         t_compile, t_dyn, t_start)
+
+
+def _flagged_fraction(jit_raw_builder, args, t_compile, t_dyn, t_start):
+    """Fraction of evaluated cases whose solver-health status word
+    carries SEVERE bits (unconverged statics/drag, ill-conditioned Z,
+    non-finite output — see raft_tpu.utils.health)."""
+    from raft_tpu.utils import health
+
+    st = _pruned_probe(jit_raw_builder, "status", args,
+                       t_compile, t_dyn, t_start)
+    if st is None:
+        return None
+    return float(((st & np.int32(health.SEVERE)) != 0).mean())
+
+
 def _finish_breakdown(breakdown, t_compile, dt, t_stat, t_dyn,
                       base_per_sec, batch_designs, distinct_geometries,
-                      iters=None, ndof=6, recompiles=None):
+                      iters=None, ndof=6, recompiles=None, flagged=None):
     """Shared breakdown block.  Stage prefixes are reported as RAW
     times of their own executables (differences between separately
     compiled programs can be negative and misattribute time); derived
@@ -785,6 +803,9 @@ def _finish_breakdown(breakdown, t_compile, dt, t_stat, t_dyn,
         # compiles observed during the steady-state timing reps — any
         # nonzero value means the headline number includes XLA work
         steady_state_recompiles=recompiles,
+        # solver-health probe: severe-bit fraction across the batch
+        flagged_fraction=(round(flagged, 4) if flagged is not None
+                          else None),
     )
     breakdown.update(
         compile_s=round(t_compile, 2),
@@ -856,9 +877,10 @@ def run_mode(mode):
         lambda key: jax.jit(jax.vmap(
             lambda *a: jnp.sum(jnp.abs(eval_case(*a, key=key))))),
         args, reps, t_compile, dt, t_start)
-    iters = _drag_iters(
-        lambda key: jax.jit(jax.vmap(lambda *a: eval_case(*a, key=key))),
-        args, t_compile, t_dyn, t_start)
+    raw_builder = lambda key: jax.jit(
+        jax.vmap(lambda *a: eval_case(*a, key=key)))
+    iters = _drag_iters(raw_builder, args, t_compile, t_dyn, t_start)
+    flagged = _flagged_fraction(raw_builder, args, t_compile, t_dyn, t_start)
 
     # optional profiler capture (point RAFT_TPU_PROFILE at a directory
     # and open the trace in TensorBoard / Perfetto)
@@ -871,7 +893,8 @@ def run_mode(mode):
     breakdown = _finish_breakdown(
         _flops_breakdown(compiled, dt), t_compile, dt, t_stat, t_dyn,
         base_design_evals_per_sec, B, True, iters=iters,
-        ndof=model.fowtList[0].nDOF, recompiles=n_recompiles)
+        ndof=model.fowtList[0].nDOF, recompiles=n_recompiles,
+        flagged=flagged)
     print(json.dumps({
         "metric": "design-evals/sec/chip (VolturnUS-S geometry DoE, 100w x 12 cases, operating turbine)",
         "value": round(design_evals_per_sec, 3),
@@ -965,15 +988,16 @@ def run_flat(t_start=None):
         lambda key: jax.jit(jax.vmap(
             lambda *a: jnp.sum(jnp.abs(eval_case(*a, key=key))))),
         args, reps, t_compile, dt, t_start)
-    iters = _drag_iters(
-        lambda key: jax.jit(jax.vmap(lambda *a: eval_case(*a, key=key))),
-        args, t_compile, t_dyn, t_start)
+    raw_builder = lambda key: jax.jit(
+        jax.vmap(lambda *a: eval_case(*a, key=key)))
+    iters = _drag_iters(raw_builder, args, t_compile, t_dyn, t_start)
+    flagged = _flagged_fraction(raw_builder, args, t_compile, t_dyn, t_start)
 
     base = _numpy_baseline(model)
     breakdown = _finish_breakdown(
         _flops_breakdown(compiled, dt), t_compile, dt, t_stat, t_dyn,
         base, B, False, iters=iters, ndof=model.fowtList[0].nDOF,
-        recompiles=n_recompiles)
+        recompiles=n_recompiles, flagged=flagged)
     print(json.dumps({
         "metric": "design-evals/sec/chip (VolturnUS-S, 100w x 12 cases, operating turbine)",
         "value": round(design_evals_per_sec, 3),

@@ -19,3 +19,9 @@ else
 fi
 
 python -m raft_tpu.analysis lint
+
+# jaxpr contracts over the health-instrumented entry points
+# (solve_dynamics_fowt, the design evaluator, the status fold): the
+# status word must stay gather-free/callback-free and inside the
+# checked-in primitive budgets (raft_tpu/analysis/primitive_baseline.json)
+python -m raft_tpu.analysis contracts

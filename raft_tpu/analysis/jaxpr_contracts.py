@@ -2,10 +2,12 @@
 
 The public entry points — :func:`raft_tpu.models.dynamics.
 solve_dynamics_fowt`, :func:`~raft_tpu.models.dynamics.system_response`,
-:func:`raft_tpu.physics.morison.drag_lin_iter` and the design-sweep
-evaluator (:func:`raft_tpu.api.make_design_evaluator`) — are traced
-(``jax.make_jaxpr``, no compile/execute) on the bundled spar design and
-checked against contracts:
+:func:`raft_tpu.physics.morison.drag_lin_iter`, the design-sweep
+evaluator (:func:`raft_tpu.api.make_design_evaluator`) and the
+solver-health status fold (:mod:`raft_tpu.utils.health`, entry
+``health_status``) — are traced (``jax.make_jaxpr``, no
+compile/execute) on the bundled spar design and checked against
+contracts:
 
 * **structure** — hard per-primitive ceilings.  The central one
   generalizes the PR-2 hand-written guard: the drag fixed-point body
@@ -28,10 +30,12 @@ checked against contracts:
   of landing as a silent slowdown.  Regenerate after an intentional
   change with ``python -m raft_tpu.analysis baseline --write``.
 
-Tracing pins ``RAFT_TPU_SOLVER=native`` and ``RAFT_TPU_SCAN_CHUNK`` to
-their defaults and traces BOTH fixed-point drivers ('while'/'scan') and
-BOTH dtype policies, so the baseline is reproducible on any host and
-the accelerator-path jaxpr is guarded from a CPU CI runner.
+Tracing pins ``RAFT_TPU_SOLVER=native``, ``RAFT_TPU_SCAN_CHUNK`` and
+the solver-health flags (``COND_CHECK``/``COND_THRESHOLD``/
+``ITER_SCALE``) to their defaults and traces BOTH fixed-point drivers
+('while'/'scan') and BOTH dtype policies, so the baseline is
+reproducible on any host and the accelerator-path jaxpr is guarded
+from a CPU CI runner.
 """
 
 from __future__ import annotations
@@ -98,6 +102,13 @@ CONTRACTS = {
     "design_evaluator": Contract(
         "design_evaluator", dtype_clean="",
         fixed_point_modes=("while", "scan")),
+    # the solver-health status-assembly path (raft_tpu.utils.health +
+    # the evaluators' _case_status fold): pure elementwise bit
+    # arithmetic — no gathers, no host callbacks, and under the f32
+    # policy nothing 64-bit (the word itself stays int32; asserted in
+    # tests/test_health.py)
+    "health_status": Contract(
+        "health_status", max_prims={"gather": 0, "dynamic_slice": 0}),
 }
 
 
@@ -219,8 +230,14 @@ class EntryPointTracer:
         dtype, _, fp = variant.partition("+")
         model, fs, fh = self.model, self.fs, self.fh
         nDOF, nw = fs.nDOF, model.nw
+        # every trace-time flag that shapes the jaxpr is pinned (None =
+        # registry default), so an operator's exported RAFT_TPU_* env —
+        # e.g. COND_CHECK=1 left on while debugging — can neither flap
+        # the CI budgets nor get baked into a regenerated baseline
         with _flag_env(DTYPE=dtype, FIXED_POINT=fp or None,
-                       SOLVER="native", SCAN_CHUNK=None):
+                       SOLVER="native", SCAN_CHUNK=None,
+                       COND_CHECK=None, COND_THRESHOLD=None,
+                       ITER_SCALE=None):
             rdt, cdt = compute_dtypes(policy=dtype)
             w = jnp.asarray(model.w, dtype=rdt)
             if entry == "drag_lin_iter":
@@ -256,6 +273,28 @@ class EntryPointTracer:
                     {"Hs": p[0], "Tp": p[1], "beta": p[2],
                      "Cd_scale": p[3]}))(
                     jnp.asarray([6.0, 12.0, 0.0, 1.0], dtype=rdt))
+            if entry == "health_status":
+                # the evaluators' status fold at representative shapes:
+                # statics word | dynamics word | output-finiteness and
+                # input-clip guards (mirrors raft_tpu.api._case_status)
+                from raft_tpu.utils import health
+
+                def fold(st_statics, drag_converged, cond_Z, X0, Xi):
+                    status = health.set_bit(
+                        st_statics, health.DRAG_CAP_HIT, ~drag_converged)
+                    status = health.set_bit(
+                        status, health.ILL_CONDITIONED_Z, cond_Z > 1e7)
+                    status = health.set_bit(
+                        status, health.NONFINITE_INTERMEDIATE,
+                        ~(jnp.all(jnp.isfinite(X0))
+                          & jnp.all(jnp.isfinite(Xi))))
+                    return jnp.asarray(status, dtype=jnp.int32)
+
+                return jax.make_jaxpr(fold)(
+                    jnp.zeros((), dtype=jnp.int32), jnp.asarray(False),
+                    jnp.zeros((), dtype=rdt),
+                    jnp.zeros((nDOF,), dtype=rdt),
+                    jnp.zeros((nDOF, nw), dtype=cdt))
         raise KeyError(f"unknown entry point {entry!r}")
 
 
@@ -373,7 +412,8 @@ def run_checks(design=None, dtype_modes=("float64", "float32"),
         payload = dict(
             design=os.path.basename(design or DEFAULT_DESIGN),
             jax=jax.__version__,
-            pinned_flags=dict(SOLVER="native", SCAN_CHUNK="default"),
+            pinned_flags=dict(SOLVER="native", SCAN_CHUNK="default",
+                              COND_CHECK="default", ITER_SCALE="default"),
             slack=dict(prim_ratio=PRIM_RATIO, prim_abs=PRIM_ABS,
                        total_ratio=TOTAL_RATIO, total_abs=TOTAL_ABS),
             entries=measured)

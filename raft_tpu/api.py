@@ -27,6 +27,7 @@ from raft_tpu.physics import morison
 from raft_tpu.physics.mooring import mooring_stiffness
 from raft_tpu.physics.statics import calc_statics, node_T, platform_kinematics
 from raft_tpu.ops import waves as wv
+from raft_tpu.utils import health
 from raft_tpu.utils.dtypes import compute_dtypes
 
 
@@ -36,6 +37,21 @@ def _policy_cdt():
     the x64-canonical complex dtype, i.e. the historical
     behaviour)."""
     return compute_dtypes()[1]
+
+
+def _case_status(st_status, dyn_diag, X0, Xi, input_clipped=False):
+    """Assemble one case's solver-health word (int32, vmap-safe): the
+    statics Newton bits OR the dynamics-solve bits OR evaluator-level
+    guards (non-finite outputs, clamped inputs).  Every traced
+    evaluator returns this as the first-class ``"status"`` output —
+    the in-band replacement for host warnings that cannot survive a
+    pjit sweep (see :mod:`raft_tpu.utils.health`)."""
+    status = st_status | dyn_diag["status"]
+    status = health.set_bit(
+        status, health.NONFINITE_INTERMEDIATE,
+        ~(jnp.all(jnp.isfinite(X0)) & jnp.all(jnp.isfinite(Xi))))
+    status = health.set_bit(status, health.INPUT_CLIPPED, input_clipped)
+    return jnp.asarray(status, dtype=jnp.int32)
 
 
 def make_design_evaluator(model):
@@ -95,7 +111,8 @@ def make_design_evaluator(model):
             ms = dataclasses.replace(ms0, L=jnp.asarray(ms0.L) * L_s)
 
         # mean offsets
-        X0, _ = solve_equilibrium(fs, ms, K_h, F_und, jnp.zeros(nDOF))
+        X0, _, _, _, st_status = solve_equilibrium(
+            fs, ms, K_h, F_und, jnp.zeros(nDOF))
 
         r_nodes, R_ptfm, r_root = platform_kinematics(fs, X0)
         Tn = node_T(r_nodes, r_root)
@@ -129,6 +146,7 @@ def make_design_evaluator(model):
             drag_resid=dyn_diag["drag_resid"],
             drag_converged=dyn_diag["drag_converged"],
             n_iter_drag=dyn_diag["n_iter_drag"],
+            status=_case_status(st_status, dyn_diag, X0, Xi),
         )
 
     return evaluate
@@ -483,6 +501,7 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
         B00 = jnp.zeros((nw, max(fs.nrotors, 1)))
         Om_out = jnp.zeros(max(fs.nrotors, 1))
         pitch_out = jnp.zeros(max(fs.nrotors, 1))
+        input_clipped = jnp.asarray(False)
         for ir, rot in enumerate(rotor_aero):
             rprops = fs.rotors[ir]
             if rprops.aeroServoMod <= 0:
@@ -493,6 +512,7 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
             ts = turb_static or ("NTM", 50.0)
             on = speed > 0
             speed_safe = jnp.maximum(speed, 0.1)
+            input_clipped = input_clipped | (on & (speed < 0.1))
             f0, f6, a6, b6, Bg, qv = calc_aero_traced(
                 rot, rprops, w, speed_safe, heading, TI, yaw_command_rad=yaw_cmd,
                 turb_static=ts)
@@ -519,7 +539,7 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
         from raft_tpu.models.statics_solve import solve_equilibrium_general, single_ms_closures
         force, stiff = single_ms_closures(ms_t, nDOF)
         F_env = F_current + f_aero0
-        X0, _ = solve_equilibrium_general(
+        X0, _, _, _, st_status = solve_equilibrium_general(
             jnp.asarray(K_h_t), jnp.asarray(F_und_t), F_env, force, stiff,
             tol_vec, caps, refs, C_elast=jnp.asarray(C_elast_t))
 
@@ -589,10 +609,11 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
         # offsets (raft_model.py:316-328); Xi is not recomputed
         X0_out = X0
         if Qm is not None:
-            X0_out, _ = solve_equilibrium_general(
+            X0_out, _, _, _, st2 = solve_equilibrium_general(
                 jnp.asarray(K_h_t), jnp.asarray(F_und_t),
                 F_env + jnp.sum(F_2nd_mean, axis=0), force, stiff,
                 tol_vec, caps, refs, C_elast=jnp.asarray(C_elast_t))
+            st_status = st_status | st2
 
         RAO = wv.get_rao(Xi[0], zeta[0])
         PSD = jnp.sum(0.5 * jnp.abs(Xi) ** 2 / dw, axis=0)
@@ -604,6 +625,8 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
             drag_resid=dyn_diag["drag_resid"],
             drag_converged=dyn_diag["drag_converged"],
             n_iter_drag=dyn_diag["n_iter_drag"],
+            status=_case_status(st_status, dyn_diag, X0_out, Xi,
+                                input_clipped=input_clipped),
         )
 
     evaluate.geometry_constants = geometry_constants
@@ -693,6 +716,7 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
 
         # ---- per-FOWT aero-servo constants + current loads
         f_env_parts, aero = [], []
+        input_clipped = jnp.asarray(False)
         for i, fs_i in enumerate(fowts):
             nDOF = fs_i.nDOF
             f0_i = jnp.zeros(nDOF)
@@ -708,6 +732,7 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
                 heading = jnp.deg2rad(cur_heading if current else wind_heading)
                 on = speed > 0
                 speed_safe = jnp.maximum(speed, 0.1)
+                input_clipped = input_clipped | (on & (speed < 0.1))
                 f0, f6, a6, b6, Bg, qv = calc_aero_traced(
                     rot, rprops, w, speed_safe, heading, TI,
                     yaw_command_rad=yaw_cmd,
@@ -727,7 +752,7 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
         # ---- coupled equilibrium (shared mooring through the closures)
         from raft_tpu.models.statics_solve import solve_equilibrium_general
         F_env = jnp.concatenate(f_env_parts)
-        X0, _ = solve_equilibrium_general(
+        X0, _, _, _, st_status = solve_equilibrium_general(
             jnp.asarray(K_h), jnp.asarray(F_und), F_env, force, stiff,
             tol_vec, caps, refs, C_elast=jnp.asarray(C_elast))
 
@@ -736,7 +761,7 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
         zeta = jnp.sqrt(2.0 * S * dw).astype(_policy_cdt())
 
         # ---- per-FOWT excitation + drag-linearised impedance
-        Z_blocks, resids, iters = [], [], []
+        Z_blocks, resids, iters, dyn_statuses = [], [], [], []
         F_waves = [[] for _ in range(nWaves)]
         for i, fs_i in enumerate(fowts):
             nDOF = fs_i.nDOF
@@ -769,6 +794,7 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
             Z_blocks.append(Z_i)
             resids.append(diag_i["drag_resid"])
             iters.append(diag_i["n_iter_drag"])
+            dyn_statuses.append(diag_i["status"])
             for ih in range(nWaves):
                 F_drag = morison.drag_excitation(
                     fs_i, sss[i], hc, Bmat, exc["u"][ih], Tn, r_nodes)
@@ -796,9 +822,17 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
         Xi = jnp.concatenate(
             [Xi, jnp.zeros((1, nDOF_T, nw), dtype=Xi.dtype)])
         PSD = jnp.sum(0.5 * jnp.abs(Xi) ** 2 / dw, axis=0)
+        # one status word for the coupled case: any unit's drag/dynamics
+        # bits OR the coupled statics bits OR the output guards
+        dyn_status = dyn_statuses[0]
+        for st_i in dyn_statuses[1:]:
+            dyn_status = dyn_status | st_i
+        status = _case_status(st_status, dict(status=dyn_status), X0, Xi,
+                              input_clipped=input_clipped)
         return dict(X0=X0, Xi=Xi, PSD=PSD, S=S, zeta=zeta,
                     drag_resid=jnp.stack(resids),
-                    n_iter_drag=jnp.stack(iters))
+                    n_iter_drag=jnp.stack(iters),
+                    status=status)
 
     return evaluate
 
@@ -941,6 +975,7 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None,
         A_aero = jnp.zeros((nDOF, nDOF, nw))
         B_aero = jnp.zeros((nDOF, nDOF, nw))
         B_gyro = jnp.zeros((nDOF, nDOF))
+        input_clipped = jnp.asarray(False)
         for ir, rot in enumerate(rotor_aero):
             rprops = fs.rotors[ir]
             if rprops.aeroServoMod <= 0:
@@ -950,6 +985,7 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None,
             heading = jnp.deg2rad(cur_heading if current else wind_heading)
             on = speed > 0
             speed_safe = jnp.maximum(speed, 0.1)
+            input_clipped = input_clipped | (on & (speed < 0.1))
             f0, f6, a6, b6, Bg, qv = calc_aero_traced(
                 rot, rprops, w, speed_safe, heading, TI,
                 yaw_command_rad=yaw_cmd,
@@ -967,7 +1003,7 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None,
 
         # ---- equilibrium
         F_env = F_current + f_aero0
-        X0, _ = solve_equilibrium_general(
+        X0, _, _, _, st_status = solve_equilibrium_general(
             jnp.asarray(K_h_t), jnp.asarray(F_und_t), F_env, force_t, stiff_t,
             tol_vec, caps, refs, C_elast=jnp.asarray(C_elast_t))
 
@@ -1010,7 +1046,9 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None,
         return dict(X0=X0, Xi=Xi, PSD=PSD, S=S, zeta=zeta,
                     drag_resid=dyn_diag["drag_resid"],
                     drag_converged=dyn_diag["drag_converged"],
-                    n_iter_drag=dyn_diag["n_iter_drag"])
+                    n_iter_drag=dyn_diag["n_iter_drag"],
+                    status=_case_status(st_status, dyn_diag, X0, Xi,
+                                        input_clipped=input_clipped))
 
     return evaluate
 
@@ -1043,7 +1081,8 @@ def make_case_evaluator(model, n_stat_iter=12):
 
     def evaluate(Hs, Tp, beta):
         # --- mean offsets under zero mean environmental load
-        X0, _ = solve_equilibrium(fs, ms, K_h, F_und, jnp.zeros(nDOF))
+        X0, _, _, _, st_status = solve_equilibrium(
+            fs, ms, K_h, F_und, jnp.zeros(nDOF))
 
         # --- pose-dependent geometry
         r_nodes, R_ptfm, r_root = platform_kinematics(fs, X0)
@@ -1084,6 +1123,7 @@ def make_case_evaluator(model, n_stat_iter=12):
         return dict(X0=X0, Xi=Xi, RAO=RAO, PSD=PSD, S=S,
                     drag_resid=dyn_diag["drag_resid"],
                     drag_converged=dyn_diag["drag_converged"],
-                    n_iter_drag=dyn_diag["n_iter_drag"])
+                    n_iter_drag=dyn_diag["n_iter_drag"],
+                    status=_case_status(st_status, dyn_diag, X0, Xi))
 
     return evaluate

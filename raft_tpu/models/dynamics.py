@@ -43,7 +43,7 @@ import jax.numpy as jnp
 
 from raft_tpu.ops import linsolve
 from raft_tpu.physics import morison
-from raft_tpu.utils import config
+from raft_tpu.utils import config, health
 from raft_tpu.utils.dtypes import compute_dtypes
 
 
@@ -95,8 +95,12 @@ def solve_dynamics_fowt(
 
     Returns (Z (nw,nDOF,nDOF), Xi (nDOF,nw), Bmat (S,3,3),
     diag dict with drag_resid (scalar) / drag_converged (bool) — the
-    stopping-rule residual of the returned linearisation point — and
-    n_iter_drag, the realized iteration count of the fixed point).
+    stopping-rule residual of the returned linearisation point —
+    n_iter_drag, the realized iteration count of the fixed point,
+    cond_Z, the max one-step Hager estimate of kappa_1(Z(w)) (0 unless
+    RAFT_TPU_COND_CHECK), and status, the int32 solver-health word
+    (DRAG_CAP_HIT / ILL_CONDITIONED_Z / NONFINITE_INTERMEDIATE bits,
+    see :mod:`raft_tpu.utils.health`)).
     """
     nDOF, nw = F_lin.shape
     rdt, cdt = compute_dtypes(M_lin, F_lin, w, policy=dtype)
@@ -142,7 +146,11 @@ def solve_dynamics_fowt(
     # 1e-2).  Sweeps that prefer the true fixed point over golden
     # compatibility can grant n_iter_extra additional under-relaxed
     # iterations, taken ONLY when the reference cap strikes unconverged.
-    cap = n_iter + 1 + max(int(n_iter_extra), 0)
+    # RAFT_TPU_ITER_SCALE (trace-time, default 1) multiplies the base
+    # budget — the escalation re-solver's "larger budget" rung; at 1
+    # the cap is exactly the reference's.
+    iter_scale = max(int(config.get("ITER_SCALE")), 1)
+    cap = n_iter * iter_scale + 1 + max(int(n_iter_extra), 0)
 
     def step(XiLast, it):
         """One masked fixed-point step (shared by both loop drivers).
@@ -259,9 +267,25 @@ def solve_dynamics_fowt(
     # stopping rule?  (the reference warns on non-convergence,
     # raft_model.py:1138-1140; sweeps use this to flag bad cases)
     tolCheck = jnp.max(jnp.abs(Xi - XiLast) / (jnp.abs(Xi) + tol))
+    drag_converged = tolCheck < tol
+    # solver-health word (raft_tpu.utils.health): in-band, vmap-safe
+    # bits that survive where a host warning cannot (pjit sweeps)
+    status = health.set_bit(jnp.zeros((), dtype=jnp.int32),
+                            health.DRAG_CAP_HIT, ~drag_converged)
+    status = health.set_bit(status, health.NONFINITE_INTERMEDIATE,
+                            ~jnp.all(jnp.isfinite(Xi)))
+    if config.get("COND_CHECK"):
+        # guarded numerics: one-step Hager estimate of kappa_1(Z(w))
+        # (one extra batched solve, trace-time gated so the default
+        # program is untouched)
+        cond_Z = jnp.max(linsolve.cond_estimate(Z))
+        status = health.set_bit(status, health.ILL_CONDITIONED_Z,
+                                cond_Z > config.get("COND_THRESHOLD"))
+    else:
+        cond_Z = jnp.zeros((), dtype=rdt)
     return Z, Xi, Bmat, dict(
-        drag_resid=tolCheck, drag_converged=tolCheck < tol,
-        n_iter_drag=n_real)
+        drag_resid=tolCheck, drag_converged=drag_converged,
+        n_iter_drag=n_real, cond_Z=cond_Z, status=status)
 
 
 def system_response(Z_sys, F_waves):

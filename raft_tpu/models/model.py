@@ -261,9 +261,26 @@ class Model:
         C_elast = jnp.asarray(scipy.linalg.block_diag(*C_elast_blocks))
         tol_vec, caps, refs = make_tolerances(self.fowtList)
         force, stiff = self._mooring_closures()
-        X, Fres = solve_equilibrium_general(
+        X, Fres, n_iter, converged, st_status = solve_equilibrium_general(
             K_h, F_und, F_env, force, stiff, tol_vec, caps, refs,
             C_elast=C_elast)
+        self.statics_status = int(st_status)
+        if not bool(converged):
+            # mirror the drag-linearisation warning in solve_dynamics:
+            # the Newton budget struck with the step rule unmet, so the
+            # reported equilibrium is the capped iterate
+            import warnings
+
+            from raft_tpu.utils import health
+            from raft_tpu.utils.structlog import log_event
+
+            warnings.warn(
+                "solveStatics Newton did not converge within "
+                f"{int(n_iter)} iterations "
+                f"(status: {health.describe(int(st_status))})")
+            log_event("statics_unconverged", n_iter=int(n_iter),
+                      status=int(st_status),
+                      reason=health.describe(int(st_status)))
         self.X0 = X
         return X
 
@@ -913,10 +930,13 @@ class Model:
             for i, inf in enumerate(info.get("infos", [])):
                 dd = inf.get("dyn_diag")
                 if dd is not None:
+                    from raft_tpu.utils import health
                     log_event("drag_linearisation", case=iCase, fowt=i,
                               resid=float(dd["drag_resid"]),
                               converged=bool(dd["drag_converged"]),
-                              n_iter=int(dd["n_iter_drag"]))
+                              n_iter=int(dd["n_iter_drag"]),
+                              status=int(dd["status"]),
+                              reason=health.describe(int(dd["status"])))
             # feed mean drift back into the equilibrium for ANY 2nd-order
             # configuration — the reference re-runs solveStatics with
             # Fhydro_2nd_mean whenever potSecOrder > 0, slender-body QTFs

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.physics.mooring import mooring_force, mooring_stiffness
+from raft_tpu.utils import config, health
 
 
 def make_tolerances(fowtList):
@@ -80,12 +81,34 @@ def solve_equilibrium_general(
     position X_ref (array FOWTs sit at nonzero x/y; raft_model.py:698-707).
     ``discard_subtol_step`` reproduces dsolve2's convergence semantics
     (the final sub-tolerance step is not applied), which the reference's
-    published equilibria correspond to."""
+    published equilibria correspond to.
+
+    Returns ``(X, F_resid, n_iter, converged, status)``: the
+    equilibrium pose, the residual force at it, the realized Newton
+    iteration count, the stopping-rule verdict, and the solver-health
+    word (:mod:`raft_tpu.utils.health`) carrying ``STATICS_MAX_ITER``
+    when the budget struck unconverged and ``STATICS_STEP_CAPPED`` when
+    any applied step saturated the per-DOF cap — all traced, vmap-safe
+    values (no host exception can carry them out of a pjit sweep).
+
+    ``RAFT_TPU_ITER_SCALE`` (trace-time, default 1) multiplies
+    ``max_iter`` — the escalation re-solver's "larger budget" rung;
+    at 1 the loop is iteration-for-iteration the reference's."""
     nDOF = F_undisplaced.shape[0]
     if X0 is None:
         X0 = jnp.asarray(X_ref)
     if C_elast is None:
-        C_elast = jnp.zeros((nDOF, nDOF))
+        # derive the placeholder's dtype from the stiffness it joins:
+        # a default-f64 zeros would silently promote an f32 solve
+        C_elast = jnp.zeros((nDOF, nDOF),
+                            dtype=jnp.asarray(K_hydrostatic).dtype)
+    max_iter_eff = max_iter * max(int(config.get("ITER_SCALE")), 1)
+    # aux counters in the solve's own float dtype: custom_root's JVP
+    # rule cannot produce the float0 tangents int/bool aux would need
+    # (same pattern as the drag fixed point, models/dynamics.py)
+    ft = jnp.asarray(X0).dtype
+    zero = jnp.zeros((), dtype=ft)
+    one = jnp.ones((), dtype=ft)
 
     def net_force(X):
         return (
@@ -100,25 +123,30 @@ def solve_equilibrium_general(
         F = net_force(X)
         K = K_hydrostatic + C_elast + mooring_stiffness_fn(X)
         dX = jnp.linalg.solve(K, F)
-        return jnp.clip(dX, -step_cap, step_cap)
+        return jnp.clip(dX, -step_cap, step_cap), dX
 
     def body(carry):
-        X, it, _ = carry
-        dX = step(X)
+        X, it, _, capped = carry
+        dX, dX_raw = step(X)
         done = jnp.all(jnp.abs(dX) < tol_vec)
+        hit = jnp.any(jnp.abs(dX_raw) > step_cap)
         if discard_subtol_step:
             X = jnp.where(done, X, X + dX)
         else:
             X = X + dX
-        return X, it + 1, done
+        # count cap-saturated steps that were actually applied (the
+        # discarded sub-tolerance step cannot saturate the cap anyway)
+        capped = capped + jnp.where(done | ~hit, zero, one)
+        return X, it + one, done, capped
 
     def cond(carry):
-        _, it, done = carry
-        return (it < max_iter) & (~done)
+        _, it, done, _ = carry
+        return (it < max_iter_eff) & (~done)
 
     def run_newton(f, Xinit):
-        X, _, _ = jax.lax.while_loop(cond, body, (Xinit, 0, jnp.asarray(False)))
-        return X
+        X, it, done, capped = jax.lax.while_loop(
+            cond, body, (Xinit, zero, jnp.asarray(False), zero))
+        return X, (it, jnp.where(done, one, zero), capped)
 
     def tangent_solve(g, y):
         # g is the linearized residual (the equilibrium Jacobian); the
@@ -131,8 +159,15 @@ def solve_equilibrium_general(
     # while_loop; gradients flow through the implicit function theorem,
     # enabling jax.grad (reverse mode) of response metrics wrt design
     # parameters (SURVEY.md §7.1)
-    X = jax.lax.custom_root(net_force, X0, run_newton, tangent_solve)
-    return X, net_force(X)
+    X, (it_f, done_f, capped_f) = jax.lax.custom_root(
+        net_force, X0, run_newton, tangent_solve, has_aux=True)
+    n_iter = jnp.asarray(jax.lax.stop_gradient(it_f), dtype=jnp.int32)
+    converged = jax.lax.stop_gradient(done_f) > 0.5
+    step_capped = jax.lax.stop_gradient(capped_f) > 0.5
+    status = health.set_bit(
+        jnp.zeros((), dtype=jnp.int32), health.STATICS_MAX_ITER, ~converged)
+    status = health.set_bit(status, health.STATICS_STEP_CAPPED, step_capped)
+    return X, net_force(X), n_iter, converged, status
 
 
 def solve_equilibrium(

@@ -78,6 +78,28 @@ def solve(Z, F, path=None):
     return _native_solve(Z, F)
 
 
+def cond_estimate(Z, path=None):
+    """Cheap 1-norm condition estimate of batched small systems.
+
+    ``kappa_1(Z) = ||Z||_1 ||Z^-1||_1`` with ``||Z||_1`` exact (max
+    column abs sum) and ``||Z^-1||_1`` lower-bounded by one Hager
+    step: ``x = Z^-1 e`` with ``e = ones/N`` (so ``||e||_1 = 1``)
+    gives ``||x||_1 <= ||Z^-1||_1``.  One extra batched solve of the
+    system being health-checked — the same kernel, so the estimate
+    rides the native path and fuses with it.  Being a lower bound it
+    can under-flag a pathological matrix, never false-positive a
+    healthy one; the solver-health layer (``RAFT_TPU_COND_CHECK``)
+    compares it against ``RAFT_TPU_COND_THRESHOLD``.
+
+    Z : (..., N, N) complex -> (...) real estimate.
+    """
+    N = Z.shape[-1]
+    norm1 = jnp.max(jnp.sum(jnp.abs(Z), axis=-2), axis=-1)
+    e = jnp.full(Z.shape[:-2] + (N,), 1.0 / N, dtype=Z.dtype)
+    inv_lb = jnp.sum(jnp.abs(solve(Z, e, path=path)), axis=-1)
+    return norm1 * inv_lb
+
+
 def _native_solve(Z, F):
     """Pivot-free blocked elimination of the real 2N x 2N embedding.
 

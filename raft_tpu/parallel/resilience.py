@@ -28,18 +28,29 @@ missing pieces:
 * **NaN/Inf quarantine** — a per-row finiteness check after each shard;
   offending case parameters land in ``quarantine.json`` (with an
   optional solo re-evaluation on the CPU backend) so non-finite rows
-  are auditable instead of silently poisoning aggregates.
+  are auditable instead of silently poisoning aggregates;
+* **status-aware quarantine + escalation ladder** — when the sweep
+  carries the ``"status"`` out_key (the in-band int32 solver-health
+  word, :mod:`raft_tpu.utils.health`), rows with SEVERE bits —
+  finite-but-unconverged Newton/drag solves, ill-conditioned impedance
+  — are caught too, not just NaNs.  ``RAFT_TPU_ESCALATE`` selects the
+  degradation path: ``off`` (flag + record only), ``retol`` (re-solve
+  the row with ``RAFT_TPU_ESCALATE_ITER_SCALE`` x the iteration
+  budget), ``f64_cpu`` (retol, then float64 on the CPU backend).  Each
+  rung's outcome — cleared vs persistent bits, original-vs-escalated
+  result deltas — lands per case in ``quarantine.json`` (schema v2).
 
 Every event flows through :mod:`raft_tpu.utils.structlog` (JSONL):
 ``sweep_start``, ``shard_start``, ``shard_done``, ``shard_resume``,
 ``shard_corrupt``, ``shard_retry``, ``shard_oom_split``,
-``shard_quarantine``, ``backend_fallback``, ``manifest_mismatch``,
-``sweep_done``.  Failure paths are exercised deterministically via
-:mod:`raft_tpu.utils.faults`.
+``shard_quarantine``, ``shard_escalate``, ``shard_escalate_failed``,
+``backend_fallback``, ``manifest_mismatch``, ``sweep_done``.  Failure
+paths are exercised deterministically via :mod:`raft_tpu.utils.faults`.
 """
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
@@ -48,7 +59,7 @@ import time
 
 import numpy as np
 
-from raft_tpu.utils import faults
+from raft_tpu.utils import config, faults, health
 from raft_tpu.utils.structlog import log_event
 
 MANIFEST_NAME = "manifest.json"
@@ -245,7 +256,14 @@ def record_quarantine(out_dir, shard, entries):
     """Merge quarantine ``entries`` for one shard into quarantine.json.
 
     Entries for the same shard from an earlier (superseded) computation
-    are replaced, so a recomputed shard re-judges its own rows."""
+    are replaced, so a recomputed shard re-judges its own rows.
+
+    Schema v2 (see README "Solver health"): every entry carries
+    ``status`` (int32 solver-health word) and ``reason``
+    (:func:`raft_tpu.utils.health.describe`), so NaN rows, cap-hit rows
+    and ill-conditioned rows are distinguishable; escalated rows add an
+    ``escalation`` block (rungs tried, resolving rung, status/reason
+    after, original-vs-escalated result deltas)."""
     path = _quarantine_path(out_dir)
     existing = []
     if os.path.exists(path):
@@ -261,7 +279,7 @@ def record_quarantine(out_dir, shard, entries):
     existing = [e for e in existing if e.get("shard") != shard]
     existing.extend(entries)
     existing.sort(key=lambda e: (e.get("shard", 0), e.get("index", 0)))
-    _atomic_json(path, {"version": 1, "entries": existing})
+    _atomic_json(path, {"version": 2, "entries": existing})
 
 
 def load_quarantine(out_dir):
@@ -289,6 +307,141 @@ def nonfinite_rows(out):
     if bad is None:
         return np.array([], dtype=int)
     return np.nonzero(bad)[0]
+
+
+def flagged_rows(out, mask=health.SEVERE):
+    """Indices of batch rows whose ``"status"`` word carries any bit of
+    ``mask`` ([] when the sweep did not request the status out_key)."""
+    st = out.get("status")
+    if st is None:
+        return np.array([], dtype=int)
+    st = np.asarray(st)
+    bad = (st & np.int32(mask)).reshape(st.shape[0], -1).any(axis=1)
+    return np.nonzero(bad)[0]
+
+
+def _row_status(out, i):
+    """OR-fold of one row's status word(s) as a host int (0 when the
+    sweep carries no status column)."""
+    st = out.get("status")
+    if st is None:
+        return 0
+    return int(np.bitwise_or.reduce(
+        np.asarray(st[i], dtype=np.int64).ravel(), initial=0))
+
+
+# --------------------------------------------------------------- escalation
+
+_RUNGS = {"off": (), "retol": ("retol",), "f64_cpu": ("retol", "f64_cpu")}
+
+
+def escalation_rungs():
+    """The active escalation ladder (``RAFT_TPU_ESCALATE``, re-read per
+    call): the ordered rungs a flagged row climbs until healthy."""
+    return _RUNGS[config.get("ESCALATE")]
+
+
+@contextlib.contextmanager
+def _rung_flags(rung):
+    """Pin one rung's trace-time flags around a solo re-evaluation.
+
+    ``retol`` grants the solvers ``RAFT_TPU_ESCALATE_ITER_SCALE`` x
+    their AMBIENT iteration budgets (``RAFT_TPU_ITER_SCALE``, read at
+    trace time by ``solve_equilibrium_general`` and
+    ``solve_dynamics_fowt``) — relative, not absolute, so a base sweep
+    already running with a scaled budget still escalates to a strictly
+    larger one; ``f64_cpu`` additionally forces the float64 compute
+    policy (under x64 semantics when the process runs without them).
+    The sweep memo key includes these flags
+    (:func:`raft_tpu.parallel.sweep._flags_key`), so each rung traces
+    its own program and the base program stays cached."""
+    ambient = max(int(config.get("ITER_SCALE")), 1)
+    flags = {"ITER_SCALE":
+             str(ambient * max(int(config.get("ESCALATE_ITER_SCALE")), 2))}
+    if rung == "f64_cpu":
+        flags["DTYPE"] = "float64"
+    old = {}
+    try:
+        for name, val in flags.items():
+            env = config.env_name(name)
+            old[env] = os.environ.get(env)
+            os.environ[env] = val
+        if rung == "f64_cpu":
+            import jax
+
+            if not jax.config.jax_enable_x64:
+                try:
+                    from jax.experimental import enable_x64
+                except ImportError:
+                    enable_x64 = None
+                if enable_x64 is not None:
+                    with enable_x64():
+                        yield
+                    return
+        yield
+    finally:
+        for env, val in old.items():
+            if val is None:
+                os.environ.pop(env, None)
+            else:
+                os.environ[env] = val
+
+
+def _rung_mesh(rung, mesh):
+    """The rung's target mesh: ``f64_cpu`` re-solves on the CPU backend
+    (falling back to the sweep mesh when no CPU backend exists)."""
+    if rung == "f64_cpu":
+        return _cpu_mesh(mesh) or mesh
+    return mesh
+
+
+def _result_delta(orig_row, new_row):
+    """Max-abs difference between one row's original and escalated
+    values (None for non-numeric keys or NaN-poisoned originals) — the
+    compact original-vs-escalated record for quarantine.json."""
+    try:
+        a, b = np.asarray(orig_row), np.asarray(new_row)
+        if not (np.issubdtype(a.dtype, np.number) and a.shape == b.shape):
+            return None
+        d = float(np.max(np.abs(a.astype(np.complex128)
+                                - b.astype(np.complex128))))
+        return d if np.isfinite(d) else None
+    except Exception:
+        return None
+
+
+def _escalate_row(compute, solo, status_before, mesh, shard, index):
+    """Climb the escalation ladder for one flagged row.
+
+    Returns ``(retried_row_or_None, rungs_tried, resolved_rung,
+    status_after)``.  A retried row is returned only when a rung
+    produced a HEALTHY one (finite, no SEVERE status bits) — an
+    escalated result that is still flagged is never adopted, the
+    original (auditable) values stay in the shard."""
+    tried = []
+    status_after = status_before
+    for rung in escalation_rungs():
+        tried.append(rung)
+        try:
+            with _rung_flags(rung):
+                retried = {k: np.asarray(v)[:1]
+                           for k, v in compute(solo,
+                                               _rung_mesh(rung, mesh)).items()}
+        except Exception as e:
+            log_event("shard_escalate_failed", shard=shard, index=index,
+                      rung=rung, error=str(e)[:200])
+            continue
+        st = _row_status(retried, 0)
+        if nonfinite_rows(retried).size:
+            st |= health.NONFINITE_INTERMEDIATE
+        status_after = st
+        healthy = not bool(health.any_bit(st))
+        log_event("shard_escalate", shard=shard, index=index, rung=rung,
+                  status_before=int(status_before), status_after=int(st),
+                  resolved=healthy)
+        if healthy:
+            return retried, tried, rung, st
+    return None, tried, None, status_after
 
 
 # ------------------------------------------------------- retry / degradation
@@ -442,6 +595,7 @@ def run_checkpointed(compute, cases, out_dir, shard_size, mesh, out_keys,
     t0 = time.perf_counter()
     results = []
     n_quarantined = 0
+    n_flagged = 0
     for s in range(n_shards):
         path = os.path.join(out_dir, f"shard_{s:04d}.npz")
         sl = slice(s * shard_size, min((s + 1) * shard_size, n))
@@ -450,6 +604,7 @@ def run_checkpointed(compute, cases, out_dir, shard_size, mesh, out_keys,
             try:
                 out = load_shard(path, out_keys, expect_rows=rows)
                 results.append(out)
+                n_flagged += len(flagged_rows(out))
                 log_event("shard_resume", shard=s, rows=rows)
                 if on_shard is not None:
                     on_shard(s + 1, n_shards, False)
@@ -476,22 +631,27 @@ def run_checkpointed(compute, cases, out_dir, shard_size, mesh, out_keys,
                     a[0] = np.nan
                     out[k] = a
         bad = nonfinite_rows(out)
+        flagged = flagged_rows(out)
         entries = []
-        if bad.size:
+        if bad.size or flagged.size:
             out, entries = _quarantine_shard(
-                compute, chunk, out, bad, s, sl.start, mesh,
+                compute, chunk, out, bad, flagged, s, sl.start, mesh,
                 retry_solo=quarantine_retry)
         # re-judge even when clean: a recomputed shard must clear its own
         # stale quarantine entries from a previous run (no file is
         # created for sweeps that never quarantined anything)
         if entries or os.path.exists(_quarantine_path(out_dir)):
             record_quarantine(out_dir, s, entries)
-        shard_quarantined = len(entries)  # rows still bad post-recovery
+        # rows still bad after recovery/escalation (resolved escalation
+        # entries are audit records, not quarantined rows)
+        shard_quarantined = sum(1 for e in entries if not e.get("resolved"))
         n_quarantined += shard_quarantined
+        shard_flagged = len(flagged_rows(out))  # severe bits persisting
+        n_flagged += shard_flagged
         atomic_savez(path, **out)
         mark_shard(manifest, out_dir, s, "done",
                    wall_s=round(time.perf_counter() - t_sh, 3),
-                   quarantined=shard_quarantined)
+                   quarantined=shard_quarantined, flagged=shard_flagged)
         log_event("shard_done", shard=s, rows=rows,
                   wall_s=round(time.perf_counter() - t_sh, 3))
         results.append(out)
@@ -499,31 +659,61 @@ def run_checkpointed(compute, cases, out_dir, shard_size, mesh, out_keys,
             on_shard(s + 1, n_shards, True)
 
     log_event("sweep_done", out_dir=out_dir, n_cases=n,
-              n_quarantined=n_quarantined,
+              n_quarantined=n_quarantined, n_flagged=n_flagged,
               wall_s=round(time.perf_counter() - t0, 3))
     return {k: np.concatenate([r[k] for r in results]) for k in out_keys}
 
 
-def _quarantine_shard(compute, chunk, out, bad, shard, offset, mesh,
+def _quarantine_shard(compute, chunk, out, bad, flagged, shard, offset, mesh,
                       retry_solo=True):
-    """Handle non-finite rows in one computed shard.
+    """Handle non-finite AND status-flagged rows in one computed shard.
 
-    Optionally re-evaluates each offending row solo on the CPU backend
-    (a TPU-side numerical pathology — e.g. f32 overflow in the drag
-    linearization — can converge fine in host f64); rows that stay
-    non-finite are recorded with their case parameters and left NaN in
-    the shard so downstream aggregation can never mistake them for
-    physics."""
+    With the escalation ladder OFF, NaN rows keep the original
+    behavior — optional solo re-evaluation on the CPU backend (a
+    TPU-side numerical pathology — e.g. f32 overflow in the drag
+    linearization — can converge fine in host f64), quarantine entry
+    only when the row stays non-finite — and finite-but-flagged rows
+    are recorded (reason included) without a re-solve.  With
+    ``RAFT_TPU_ESCALATE`` active, every bad row climbs the ladder and
+    gets an entry either way: resolved rows document which rung cleared
+    which bits plus original-vs-escalated result deltas, persistent
+    rows document the surviving reason.  Unhealthy rows stay as
+    computed (NaN rows stay NaN) so downstream aggregation can never
+    mistake them for physics."""
     out = {k: np.array(v) for k, v in out.items()}
     entries = []
+    rungs = escalation_rungs()
     cpu_mesh = _cpu_mesh(mesh) if retry_solo else None
-    for i in (int(b) for b in bad):
+    bad_set = {int(b) for b in bad}
+    for i in sorted(bad_set | {int(f) for f in flagged}):
+        nonfinite = i in bad_set
         keys_bad = [k for k, v in out.items()
                     if np.issubdtype(np.asarray(v).dtype, np.number)
                     and not np.isfinite(np.asarray(v[i])).all()]
+        status_before = _row_status(out, i)
+        if nonfinite:
+            status_before |= health.NONFINITE_INTERMEDIATE
+        solo = {k: v[i:i + 1] for k, v in chunk.items()}
         recovered = False
-        if cpu_mesh is not None:
-            solo = {k: v[i:i + 1] for k, v in chunk.items()}
+        escalation = None
+        status_after = status_before
+        if rungs:
+            retried, tried, resolved_by, status_after = _escalate_row(
+                compute, solo, status_before, mesh, shard, offset + i)
+            delta = None
+            if retried is not None:
+                delta = {k: _result_delta(out[k][i], retried[k][0])
+                         for k in out if k != "status"}
+                for k in out:
+                    out[k][i] = retried[k][0]
+                recovered = True
+            escalation = {
+                "mode": config.get("ESCALATE"),
+                "rungs_tried": list(tried),
+                "resolved_by": resolved_by,
+                "result_delta": delta,
+            }
+        elif nonfinite and cpu_mesh is not None:
             try:
                 retried = {k: np.asarray(v)[:1]
                            for k, v in compute(solo, cpu_mesh).items()}
@@ -531,19 +721,33 @@ def _quarantine_shard(compute, chunk, out, bad, shard, offset, mesh,
                     for k in out:
                         out[k][i] = retried[k][0]
                     recovered = True
+                    status_after = _row_status(out, i)
             except Exception as e:
                 log_event("shard_quarantine_retry_failed", shard=shard,
                           index=offset + i, error=str(e)[:200])
         log_event("shard_quarantine", shard=shard, index=offset + i,
-                  keys=keys_bad, recovered=recovered)
-        if not recovered:
-            entries.append({
+                  keys=keys_bad, recovered=recovered,
+                  status=int(status_before),
+                  reason=health.describe(status_before))
+        # escalated rows are recorded even when resolved (the ladder's
+        # outcome is part of the audit trail); the legacy NaN-only path
+        # records only rows that stayed bad
+        if rungs or not recovered:
+            entry = {
                 "shard": shard,
                 "index": offset + i,
                 "keys_nonfinite": keys_bad,
+                "status": int(status_before),
+                "reason": health.describe(status_before),
+                "status_after": int(status_after),
+                "reason_after": health.describe(status_after),
+                "resolved": bool(recovered),
                 "case": {k: np.asarray(v[i]).tolist()
                          for k, v in chunk.items()},
-            })
+            }
+            if escalation is not None:
+                entry["escalation"] = escalation
+            entries.append(entry)
     return out, entries
 
 

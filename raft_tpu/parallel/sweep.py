@@ -36,11 +36,16 @@ def _flags_key():
     """Trace-time RAFT_TPU_* flag values that shape the compiled
     program.  Part of every memo key: the registry promises flags are
     re-read per call, so a sweep after a flag flip must re-trace
-    instead of silently reusing the old-flag program."""
+    instead of silently reusing the old-flag program.  The
+    solver-health flags belong here too: the escalation re-solver
+    (raft_tpu.parallel.resilience) flips ITER_SCALE/DTYPE around solo
+    re-evaluations and relies on this key to get the rung's program
+    instead of the cached base one."""
     from raft_tpu.utils import config
 
     return tuple(config.get(k) for k in
-                 ("SOLVER", "FIXED_POINT", "SCAN_CHUNK", "DTYPE"))
+                 ("SOLVER", "FIXED_POINT", "SCAN_CHUNK", "DTYPE",
+                  "COND_CHECK", "COND_THRESHOLD", "ITER_SCALE"))
 
 
 def _cached_jit(evaluate, key, build):
@@ -139,6 +144,12 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
         back through the response solve / excitation chain and insert
         the cross-frequency collectives (drag-linearisation RMS
         statistics) itself.
+
+    ``"status"`` is a first-class out_key: every traced evaluator
+    emits the per-case int32 solver-health word
+    (:mod:`raft_tpu.utils.health`) and requesting it here persists it
+    into shards, where the checkpointed drivers' quarantine/escalation
+    logic (:mod:`raft_tpu.parallel.resilience`) consumes it.
 
     Returns the dict of stacked outputs (sharded jax arrays).
     """

@@ -152,6 +152,27 @@ _register(
          aliases={**_F32_ALIASES, **_F64_ALIASES},
          help="compute-dtype policy for the dynamics hot path "
               "(default: derive from the inputs)"),
+    # -- solver health (see raft_tpu.utils.health and README "Solver
+    #    health"): all read at trace time, so they are part of the
+    #    sweep memo key (raft_tpu.parallel.sweep._flags_key)
+    Flag("COND_CHECK", "bool", False,
+         help="fold a one-step Hager condition estimate of Z(w) into "
+              "the solver-health status word (ILL_CONDITIONED_Z)"),
+    Flag("COND_THRESHOLD", "float", 1e7,
+         help="kappa_1(Z) estimate above which ILL_CONDITIONED_Z is "
+              "set (only with RAFT_TPU_COND_CHECK)"),
+    Flag("ITER_SCALE", "int", 1,
+         help="iteration-budget multiplier for the statics Newton and "
+              "the drag fixed point (1 = reference budgets; the "
+              "escalation ladder sets this for re-solves)"),
+    Flag("ESCALATE", "choice", "off",
+         choices=("off", "retol", "f64_cpu"),
+         help="escalation ladder for status-flagged sweep rows: 'retol' "
+              "re-solves with ESCALATE_ITER_SCALE x the iteration "
+              "budget, 'f64_cpu' additionally retries under float64 on "
+              "the CPU backend"),
+    Flag("ESCALATE_ITER_SCALE", "int", 4,
+         help="RAFT_TPU_ITER_SCALE applied by the escalation rungs"),
     # -- runtime / caching
     Flag("CACHE_DIR", "str",
          default_factory=lambda: os.path.join(

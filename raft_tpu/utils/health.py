@@ -1,0 +1,122 @@
+"""In-band solver-health status bits for traced solve paths.
+
+The per-case pipeline (statics Newton -> drag-linearisation fixed
+point -> complex impedance solve) can fail *finitely*: a Newton that
+hits its iteration cap, a drag linearisation stopped by the reference
+cap, an impedance matrix solved through near-singularity — all produce
+numbers, not NaNs, and under ``vmap``/``pjit`` there is no host
+exception to carry the bad news.  The status word is the in-band
+replacement: a per-case **int32 bitmask** produced alongside the
+physics by the solvers themselves, carried through every traced
+evaluator as the ``"status"`` output, persisted into sweep shards, and
+consumed by the escalation re-solver in
+:mod:`raft_tpu.parallel.resilience`.
+
+Contract (every future backend — pmap pods, native BEM — must
+preserve it):
+
+* the word is ``int32`` everywhere, including under the
+  ``RAFT_TPU_DTYPE=float32`` policy (no 64-bit integers sneak in);
+* all helpers are pure array ops (operator overloading only — they
+  work identically on numpy values host-side and on traced jax values
+  inside ``jit``/``vmap``), with **no host callbacks**;
+* bit 0 means "this specific guard fired", absence of bits means "no
+  guard fired" — it is NOT a proof of correctness, only of silence.
+
+Bits are split into a SEVERE set (the result is suspect: escalation
+re-solves these) and an informational set (a guard engaged but the
+solve still met its stopping rule).  ``describe`` renders a host-side
+human-readable reason for logs and ``quarantine.json``.
+
+This module deliberately imports neither jax nor the flag registry:
+it is loadable from host tooling (linter, CLI) without touching a
+backend, and the helpers stay backend-agnostic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# ------------------------------------------------------------- bit registry
+
+# name -> mask.  Append-only: shard files and quarantine.json persist
+# raw masks, so reassigning a bit silently re-labels historical data.
+MASKS = {
+    # statics Newton hit its iteration budget with the step-size
+    # stopping rule unmet (solve_equilibrium_general)
+    "STATICS_MAX_ITER": 1 << 0,
+    # at least one applied Newton step saturated the per-DOF step cap
+    # (the jnp.clip in the damped Newton) — informational: the
+    # reference caps early steps routinely and still converges
+    "STATICS_STEP_CAPPED": 1 << 1,
+    # drag-linearisation fixed point stopped by the reference iteration
+    # cap with the relative-change rule unmet (solve_dynamics_fowt)
+    "DRAG_CAP_HIT": 1 << 2,
+    # one-step Hager estimate of kappa_1(Z(w)) exceeded
+    # RAFT_TPU_COND_THRESHOLD (gated by RAFT_TPU_COND_CHECK)
+    "ILL_CONDITIONED_Z": 1 << 3,
+    # a non-finite value in a solver output (X0 / Xi) — also
+    # synthesized host-side for quarantined NaN rows
+    "NONFINITE_INTERMEDIATE": 1 << 4,
+    # an input was clamped to keep the physics defined (e.g. the
+    # near-zero wind-speed floor in the aero constants) — informational
+    "INPUT_CLIPPED": 1 << 5,
+}
+
+STATICS_MAX_ITER = MASKS["STATICS_MAX_ITER"]
+STATICS_STEP_CAPPED = MASKS["STATICS_STEP_CAPPED"]
+DRAG_CAP_HIT = MASKS["DRAG_CAP_HIT"]
+ILL_CONDITIONED_Z = MASKS["ILL_CONDITIONED_Z"]
+NONFINITE_INTERMEDIATE = MASKS["NONFINITE_INTERMEDIATE"]
+INPUT_CLIPPED = MASKS["INPUT_CLIPPED"]
+
+OK = 0
+
+# bits that mean "the shipped numbers are suspect" — the escalation
+# ladder re-solves rows carrying any of these
+SEVERE = (STATICS_MAX_ITER | DRAG_CAP_HIT | ILL_CONDITIONED_Z
+          | NONFINITE_INTERMEDIATE)
+# guards that engaged without violating a stopping rule
+INFORMATIONAL = STATICS_STEP_CAPPED | INPUT_CLIPPED
+
+
+# ------------------------------------------------------------ pure helpers
+
+
+def set_bit(status, mask, cond):
+    """``status | mask`` where ``cond`` holds, ``status`` elsewhere.
+
+    Pure operator-overloading arithmetic (``bool * int32`` promotes to
+    int32 in both numpy and jax), so the same helper serves traced
+    code under jit/vmap and host-side numpy post-processing.  ``mask``
+    is a static Python int from this registry; ``cond`` broadcasts.
+    """
+    return status | (cond * np.int32(mask))
+
+
+def any_bit(status, mask=SEVERE):
+    """Boolean (array) — does ``status`` carry any bit of ``mask``?"""
+    return (status & np.int32(mask)) != 0
+
+
+def describe(status):
+    """Human-readable reason string for one host-side status value.
+
+    ``0`` renders as ``"ok"``; unknown (future) bits render as
+    ``"bit<N>"`` so old tooling degrades readably on new data.
+    """
+    s = int(status)
+    if s == 0:
+        return "ok"
+    names = [name for name, mask in MASKS.items() if s & mask]
+    known = 0
+    for mask in MASKS.values():
+        known |= mask
+    unknown = s & ~known
+    bit = 0
+    while unknown:
+        if unknown & 1:
+            names.append(f"bit{bit}")
+        unknown >>= 1
+        bit += 1
+    return "|".join(names)

@@ -75,11 +75,17 @@ def main():
                 Hs=c6[3], Tp=c6[4], beta_deg=c6[5], geom_const=gc))
             std = jnp.sqrt(jnp.sum(out["PSD"][:6] * dw, axis=-1))  # (6,)
             return dict(X0=out["X0"][:6], std=std,
-                        drag_resid=out["drag_resid"])
+                        drag_resid=out["drag_resid"],
+                        status=out["status"])
 
         per_case = jax.vmap(one_case)(case_cols)   # (12, ...)
         x0 = per_case["X0"]
         std = per_case["std"]
+        # per-design solver-health word: OR of the 12 cases' bits, so
+        # the quarantine/escalation layer sees a flagged design even
+        # when only one operating point misbehaved
+        status = jax.lax.reduce(per_case["status"], np.int32(0),
+                                jax.lax.bitwise_or, (0,))
         return dict(
             max_offset=jnp.max(jnp.hypot(x0[:, 0] + 3 * std[:, 0],
                                          x0[:, 1] + 3 * std[:, 1])),
@@ -87,6 +93,7 @@ def main():
                 jnp.max(jnp.abs(x0[:, 4]) + 3 * std[:, 4])),
             surge_std=std[:, 0], pitch_std=std[:, 4],
             X0=x0, drag_resid=jnp.max(per_case["drag_resid"]),
+            status=status,
         )
 
     g4 = bench.sample_geometry(args.n, seed=11).astype(np.float32)
@@ -122,7 +129,7 @@ def main():
         evaluate_design, {"g4": g4}, args.out, shard_size=args.shard,
         mesh=mesh,
         out_keys=("max_offset", "max_pitch_deg", "surge_std", "pitch_std",
-                  "X0", "drag_resid"),
+                  "X0", "drag_resid", "status"),
         on_shard=on_shard)
     wall = time.perf_counter() - t0
 
@@ -131,13 +138,27 @@ def main():
     # loads shards from disk in seconds and must not overwrite the
     # artifact with a bogus thousands-of-evals/s headline
     fresh_designs = min(n_fresh[0] * args.shard, n_done)
-    # quarantined designs (non-finite rows, see quarantine.json) are
+    # quarantined designs (rows still bad after recovery/escalation) are
     # excluded from the aggregates via nan-aware reductions — one
-    # non-converged drag linearization must not poison the ranges
-    quarantined = resilience.load_quarantine(args.out)
+    # non-converged drag linearization must not poison the ranges.
+    # Resolved escalation entries are audit records, not quarantined
+    # rows (same rule as the runtime's sweep_done n_quarantined).
+    quarantined = [e for e in resilience.load_quarantine(args.out)
+                   if not e.get("resolved")]
+    # per-bit solver-health counts over the whole DoE (the in-band
+    # status words persisted in the shards; see README "Solver health")
+    from raft_tpu.utils import health
+
+    status = np.asarray(out["status"])
+    n_flagged = {name: int(((status & mask) != 0).sum())
+                 for name, mask in health.MASKS.items()
+                 if ((status & mask) != 0).any()}
     summary = dict(
         n_designs=int(n_done),
         n_quarantined=len(quarantined),
+        n_flagged=n_flagged,
+        n_flagged_severe=int(
+            ((status & np.int32(health.SEVERE)) != 0).sum()),
         cases_per_design=len(bench.CASES),
         n_freq=int(model.nw),
         wall_s=round(wall, 2),

@@ -1,0 +1,509 @@
+"""Solver-health status word: registry, guarded numerics, escalation.
+
+* bit-registry + helper semantics (pure, int32, vmap-safe);
+* the statics Newton's new ``(X, F_resid, n_iter, converged, status)``
+  return: max-iter and step-cap bits on seeded synthetic systems, the
+  ``RAFT_TPU_ITER_SCALE`` escalation knob, gradients still flowing
+  through ``lax.custom_root``;
+* the drag fixed point's ``DRAG_CAP_HIT`` and the gated Hager
+  condition estimate (``RAFT_TPU_COND_CHECK``) on the bundled spar;
+* the status-assembly trace: no gathers/host callbacks, nothing
+  64-bit — the word stays int32 (the jaxpr contract engine carries the
+  same guard as entry ``health_status``);
+* the acceptance scenario end-to-end: a seeded unconverged-but-FINITE
+  statics case (float32 Newton stalled at roundoff, no NaN anywhere)
+  flows through ``sweep_cases_full`` -> checkpoint shard -> resume with
+  the right bits, is listed in ``quarantine.json`` with a
+  human-readable reason, and is resolved by the ``f64_cpu`` escalation
+  rung (retol's larger budget alone cannot fix a roundoff stall, so
+  the ladder order is exercised for real).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import raft_tpu
+from raft_tpu.models.statics_solve import solve_equilibrium_general
+from raft_tpu.parallel import resilience
+from raft_tpu.parallel.sweep import (
+    make_mesh, run_sweep_checkpointed_full, sweep_cases_full)
+from raft_tpu.utils import health
+from raft_tpu.utils.dtypes import compute_dtypes
+
+SPAR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "raft_tpu", "designs", "spar_demo.yaml")
+
+SPAR_CASE = {
+    "wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+    "turbine_status": "operating", "yaw_misalign": 0,
+    "wave_spectrum": "JONSWAP", "wave_period": 12, "wave_height": 6,
+    "wave_heading": 0, "current_speed": 0, "current_heading": 0,
+}
+
+
+def _events(path, name=None):
+    with open(path) as f:
+        evs = [json.loads(line) for line in f if line.strip()]
+    return [e for e in evs if name is None or e["event"] == name]
+
+
+@pytest.fixture
+def log_path(tmp_path, monkeypatch):
+    p = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", p)
+    return p
+
+
+MESH = None
+
+
+def mesh2():
+    global MESH
+    if MESH is None:
+        MESH = make_mesh(2)
+    return MESH
+
+
+# ------------------------------------------------------------ bit registry
+
+
+def test_bits_are_disjoint_single_bits():
+    masks = list(health.MASKS.values())
+    assert len(set(masks)) == len(masks)
+    assert all(m & (m - 1) == 0 for m in masks)  # one bit each
+    assert health.SEVERE & health.INFORMATIONAL == 0
+    combined = 0
+    for m in masks:
+        combined |= m
+    assert combined == health.SEVERE | health.INFORMATIONAL
+
+
+def test_describe_human_readable():
+    assert health.describe(health.OK) == "ok"
+    s = health.STATICS_MAX_ITER | health.DRAG_CAP_HIT
+    d = health.describe(s)
+    assert "STATICS_MAX_ITER" in d and "DRAG_CAP_HIT" in d
+    # future/unknown bits degrade readably instead of crashing tooling
+    assert "bit30" in health.describe(1 << 30)
+    assert health.any_bit(np.int32(health.DRAG_CAP_HIT))
+    assert not health.any_bit(np.int32(health.STATICS_STEP_CAPPED))
+    assert health.any_bit(np.int32(health.STATICS_STEP_CAPPED),
+                          mask=health.INFORMATIONAL)
+
+
+def test_set_bit_int32_under_jit_and_vmap():
+    def fold(cond):
+        st = health.set_bit(jnp.zeros((), jnp.int32),
+                            health.DRAG_CAP_HIT, cond)
+        return health.set_bit(st, health.INPUT_CLIPPED, ~cond)
+
+    out = jax.jit(fold)(jnp.asarray(True))
+    assert out.dtype == jnp.int32 and int(out) == health.DRAG_CAP_HIT
+    outs = jax.vmap(fold)(jnp.asarray([True, False]))
+    assert outs.dtype == jnp.int32
+    assert list(map(int, outs)) == [health.DRAG_CAP_HIT,
+                                    health.INPUT_CLIPPED]
+
+
+def _dtypes_produced(jaxpr):
+    """Set of dtype names produced by any equation, recursively."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    seen = set()
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is not None:
+                seen.add(str(dt))
+        for val in eqn.params.values():
+            vs = val if isinstance(val, (list, tuple)) else (val,)
+            for x in vs:
+                inner = getattr(x, "jaxpr", x)
+                if hasattr(inner, "eqns"):
+                    seen |= _dtypes_produced(inner)
+    return seen
+
+
+def test_status_fold_trace_clean_int32_float32():
+    """The satellite contract: the status path adds no gathers or host
+    callbacks and stays int32/float32 under the f32 policy — checked on
+    the same fold the jaxpr contract engine traces (entry
+    ``health_status``)."""
+    from raft_tpu.analysis import jaxpr_contracts as jc
+
+    def fold(st_statics, drag_converged, cond_Z, X0, Xi):
+        status = health.set_bit(st_statics, health.DRAG_CAP_HIT,
+                                ~drag_converged)
+        status = health.set_bit(status, health.ILL_CONDITIONED_Z,
+                                cond_Z > 1e7)
+        status = health.set_bit(
+            status, health.NONFINITE_INTERMEDIATE,
+            ~(jnp.all(jnp.isfinite(X0)) & jnp.all(jnp.isfinite(Xi))))
+        return jnp.asarray(status, dtype=jnp.int32)
+
+    jaxpr = jax.make_jaxpr(fold)(
+        jnp.zeros((), jnp.int32), jnp.asarray(False),
+        jnp.zeros((), jnp.float32), jnp.zeros(6, jnp.float32),
+        jnp.zeros((6, 10), jnp.complex64))
+    assert jc.check_structure("health_status", "float32", jaxpr) == []
+    produced = _dtypes_produced(jaxpr)
+    assert not produced & {"int64", "float64", "complex128"}, produced
+    assert "health_status" in jc.CONTRACTS  # engine carries the guard
+
+
+# ------------------------------------------------- statics Newton status
+
+
+def _toy_system(rdt=None):
+    rdt = rdt or jnp.zeros(()).dtype
+    K = jnp.eye(2, dtype=rdt) * jnp.asarray(100.0, rdt)
+
+    def force(X):
+        return jnp.asarray(-5.0, rdt) * X ** 3
+
+    def stiff(X):
+        return jnp.diag(jnp.asarray(15.0, rdt) * X ** 2)
+
+    tol = jnp.full(2, 1e-8, rdt)
+    caps = jnp.full(2, 50.0, rdt)
+    refs = jnp.zeros(2, rdt)
+    return K, force, stiff, tol, caps, refs
+
+
+def _solve_toy(F, max_iter=30, cap=None, rdt=None):
+    K, force, stiff, tol, caps, refs = _toy_system(rdt)
+    if cap is not None:
+        caps = jnp.full(2, cap, caps.dtype)
+    return solve_equilibrium_general(
+        K, jnp.asarray(F, K.dtype), jnp.zeros(2, K.dtype), force, stiff,
+        tol, caps, refs, max_iter=max_iter)
+
+
+def test_statics_converged_clean_status():
+    X, Fres, n_iter, converged, status = _solve_toy([1000.0, -500.0])
+    assert bool(converged)
+    assert int(status) == health.OK
+    assert 1 <= int(n_iter) < 30
+    assert float(jnp.max(jnp.abs(Fres))) < 1e-6
+
+
+def test_statics_max_iter_bit_finite_result():
+    X, Fres, n_iter, converged, status = _solve_toy([1000.0, -500.0],
+                                                    max_iter=2)
+    assert not bool(converged)
+    assert int(n_iter) == 2
+    assert bool(health.any_bit(int(status)))
+    assert int(status) & health.STATICS_MAX_ITER
+    # the failure is FINITE — exactly the class NaN-quarantine misses
+    assert bool(jnp.all(jnp.isfinite(X)))
+
+
+def test_statics_step_cap_bit_informational():
+    X, _, n_iter, converged, status = _solve_toy([1000.0, -500.0],
+                                                 max_iter=40, cap=1.0)
+    assert bool(converged)
+    assert int(status) & health.STATICS_STEP_CAPPED
+    # cap saturation alone is not severe: no escalation for it
+    assert not bool(health.any_bit(int(status)))
+
+
+def test_iter_scale_flag_escalates_budget(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_ITER_SCALE", "8")
+    X, _, n_iter, converged, status = _solve_toy([1000.0, -500.0],
+                                                 max_iter=2)
+    assert bool(converged)
+    assert int(status) == health.OK
+    assert 2 < int(n_iter) <= 16
+
+
+def test_statics_gradient_still_flows():
+    def head(f0):
+        X, *_ = _solve_toy(jnp.stack([f0, -500.0]))
+        return X[0]
+
+    g = jax.grad(head)(1000.0)
+    # implicit-function-theorem gradient: dX/dF = 1/(K + 15 X^2) at eq
+    X0 = float(head(1000.0))
+    assert np.isfinite(float(g))
+    assert float(g) == pytest.approx(1.0 / (100.0 + 15.0 * X0 ** 2),
+                                     rel=1e-6)
+
+
+def test_statics_status_vmappable():
+    f = jax.vmap(lambda f0: _solve_toy(jnp.stack([f0, -f0]), max_iter=2)[4])
+    st = f(jnp.asarray([0.0, 1000.0]))
+    assert st.dtype == jnp.int32
+    assert int(st[0]) == health.OK          # zero force: converges at once
+    assert int(st[1]) & health.STATICS_MAX_ITER
+
+
+# -------------------------------------------------- condition estimate
+
+
+def test_cond_estimate_bounds_and_detects():
+    from raft_tpu.ops import linsolve
+
+    rng = np.random.default_rng(3)
+    A = rng.normal(size=(4, 6, 6)) + 1j * rng.normal(size=(4, 6, 6))
+    A = A + 6 * np.eye(6)  # well-conditioned batch
+    est = np.asarray(linsolve.cond_estimate(jnp.asarray(A)))
+    exact = np.array([np.linalg.cond(a, 1) for a in A])
+    # one Hager step lower-bounds ||Z^-1||_1: never above the truth
+    assert np.all(est <= exact * (1 + 1e-9))
+    assert np.all(est >= 1.0)
+    # a genuinely ill-conditioned matrix is detected loudly
+    B = np.asarray(A[0])
+    B[:, 0] = B[:, 1] * (1 + 1e-12)
+    est_bad = float(linsolve.cond_estimate(jnp.asarray(B)))
+    assert est_bad > 1e8
+    # f32 policy: the estimate stays in the 32-bit pair path
+    est32 = linsolve.cond_estimate(jnp.asarray(A, dtype=jnp.complex64))
+    assert est32.dtype == jnp.float32
+
+
+# ------------------------------------------- drag/dynamics status (spar)
+
+
+@pytest.fixture(scope="module")
+def spar_model():
+    return raft_tpu.Model(SPAR)
+
+
+def test_drag_converged_spar_status_ok(spar_model):
+    _, info = spar_model.solve_dynamics(SPAR_CASE)
+    dd = info["infos"][0]["dyn_diag"]
+    assert bool(dd["drag_converged"])
+    assert int(dd["status"]) == health.OK
+    assert float(dd["cond_Z"]) == 0.0  # COND_CHECK off: gated out
+
+
+def test_drag_cap_hit_bit(spar_model):
+    old = spar_model.nIter
+    try:
+        spar_model.nIter = 0  # cap=1: the first linearisation is kept
+        _, info = spar_model.solve_dynamics(SPAR_CASE)
+    finally:
+        spar_model.nIter = old
+    dd = info["infos"][0]["dyn_diag"]
+    assert not bool(dd["drag_converged"])
+    assert int(dd["status"]) & health.DRAG_CAP_HIT
+    assert bool(health.any_bit(int(dd["status"])))
+
+
+def test_cond_check_gated_bit(spar_model, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_COND_CHECK", "1")
+    monkeypatch.setenv("RAFT_TPU_COND_THRESHOLD", "1.0")
+    _, info = spar_model.solve_dynamics(SPAR_CASE)
+    dd = info["infos"][0]["dyn_diag"]
+    assert float(dd["cond_Z"]) > 1.0
+    assert int(dd["status"]) & health.ILL_CONDITIONED_Z
+    monkeypatch.setenv("RAFT_TPU_COND_THRESHOLD", "1e30")
+    _, info = spar_model.solve_dynamics(SPAR_CASE)
+    dd = info["infos"][0]["dyn_diag"]
+    assert float(dd["cond_Z"]) > 1.0
+    assert not int(dd["status"]) & health.ILL_CONDITIONED_Z
+
+
+def test_case_evaluator_emits_status(spar_model):
+    from raft_tpu.api import make_case_evaluator
+
+    ev = make_case_evaluator(spar_model)
+    out = ev(6.0, 12.0, 0.0)
+    assert out["status"].dtype == jnp.int32
+    assert int(out["status"]) == health.OK
+
+
+# --------------------------------------- end-to-end escalation acceptance
+
+
+def make_stiff_evaluator():
+    """REAL statics Newton on a cubic-spring system whose float32 solve
+    stalls at roundoff (finite, no NaN: the residual-driven step bottoms
+    out near X*eps32 ~ 4e-7, above the 1e-8 tolerance) while the same
+    system under float64 converges in ~8 iterations.  ``F0 = 0`` rows
+    converge immediately even in f32, giving each shard a healthy and a
+    flagged row."""
+
+    def evaluate(case):
+        rdt = compute_dtypes()[0]  # honours RAFT_TPU_DTYPE at trace time
+        K, force, stiff, tol, caps, refs = _toy_system(rdt)
+        F = jnp.stack([case["F0"], -case["F0"]]).astype(rdt)
+        X, Fres, n_iter, conv, st = solve_equilibrium_general(
+            K, F, jnp.zeros(2, rdt), force, stiff, tol, caps, refs,
+            max_iter=12)
+        st = health.set_bit(st, health.NONFINITE_INTERMEDIATE,
+                            ~jnp.all(jnp.isfinite(X)))
+        return {"X0": X, "resid": Fres,
+                "status": jnp.asarray(st, jnp.int32)}
+
+    return evaluate
+
+
+CASES_F0 = np.asarray([0.0, 1000.0, 0.0, 1000.0])
+
+
+def test_unconverged_finite_flagged_through_sweep(monkeypatch):
+    """sweep_cases_full carries the status column: the f32-stalled rows
+    are flagged severe, the healthy rows clean, nothing is NaN."""
+    monkeypatch.setenv("RAFT_TPU_DTYPE", "float32")
+    out = sweep_cases_full(make_stiff_evaluator(), {"F0": CASES_F0},
+                           mesh=mesh2(), out_keys=("X0", "status"))
+    st = np.asarray(out["status"])
+    assert st.dtype == np.int32
+    assert list(st) == [health.OK, health.STATICS_MAX_ITER,
+                        health.OK, health.STATICS_MAX_ITER]
+    assert np.isfinite(np.asarray(out["X0"])).all()  # no NaNs anywhere
+
+
+def test_flagged_rows_recorded_without_escalation(tmp_path, log_path,
+                                                  monkeypatch):
+    """RAFT_TPU_ESCALATE=off: flagged-but-finite rows are listed in
+    quarantine.json with a human-readable reason, values untouched,
+    bits persisted into the shard and counted in the manifest."""
+    monkeypatch.setenv("RAFT_TPU_DTYPE", "float32")
+    out_dir = str(tmp_path / "sweep")
+    out = run_sweep_checkpointed_full(
+        make_stiff_evaluator(), {"F0": CASES_F0}, out_dir, shard_size=2,
+        mesh=mesh2(), out_keys=("X0", "status"))
+    st = np.asarray(out["status"])
+    assert list(st) == [health.OK, health.STATICS_MAX_ITER,
+                        health.OK, health.STATICS_MAX_ITER]
+    assert np.isfinite(np.asarray(out["X0"])).all()
+
+    entries = resilience.load_quarantine(out_dir)
+    assert [e["index"] for e in entries] == [1, 3]
+    for e in entries:
+        assert e["status"] == health.STATICS_MAX_ITER
+        assert e["reason"] == "STATICS_MAX_ITER"
+        assert e["resolved"] is False
+        assert e["keys_nonfinite"] == []       # the silent-failure class
+        assert "escalation" not in e
+    with open(os.path.join(out_dir, "quarantine.json")) as f:
+        assert json.load(f)["version"] == 2
+    with open(os.path.join(out_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert all(manifest["shards"][str(s)]["flagged"] == 1 for s in (0, 1))
+    done = _events(log_path, "sweep_done")
+    assert done and done[-1]["n_flagged"] == 2
+
+    # resume: bits and quarantine survive untouched, shards not re-run
+    out2 = run_sweep_checkpointed_full(
+        make_stiff_evaluator(), {"F0": CASES_F0}, out_dir, shard_size=2,
+        mesh=mesh2(), out_keys=("X0", "status"))
+    assert np.array_equal(np.asarray(out2["status"]), st)
+    assert len(resilience.load_quarantine(out_dir)) == 2
+    assert len(_events(log_path, "shard_resume")) == 2
+
+
+def test_escalation_f64_cpu_resolves_and_clears_bits(tmp_path, log_path,
+                                                     monkeypatch):
+    """The acceptance scenario end-to-end: the seeded
+    unconverged-but-finite case climbs the ladder — retol (4x budget,
+    still f32) cannot fix a roundoff stall, f64_cpu converges — and the
+    shard ships the escalated finite result with bits cleared, with the
+    whole story in quarantine.json."""
+    monkeypatch.setenv("RAFT_TPU_DTYPE", "float32")
+    monkeypatch.setenv("RAFT_TPU_ESCALATE", "f64_cpu")
+    out_dir = str(tmp_path / "sweep")
+    out = run_sweep_checkpointed_full(
+        make_stiff_evaluator(), {"F0": CASES_F0}, out_dir, shard_size=2,
+        mesh=mesh2(), out_keys=("X0", "status"))
+
+    st = np.asarray(out["status"])
+    assert list(st) == [health.OK] * 4                 # bits cleared
+    X0 = np.asarray(out["X0"])
+    assert np.isfinite(X0).all()
+    # the escalated rows carry the true (f64-converged) equilibrium:
+    # 100 x + 5 x^3 = 1000  ->  x = 4.72513...
+    np.testing.assert_allclose(X0[1], [4.7251313, -4.7251313], rtol=1e-5)
+
+    entries = resilience.load_quarantine(out_dir)
+    assert [e["index"] for e in entries] == [1, 3]
+    for e in entries:
+        assert e["status"] == health.STATICS_MAX_ITER
+        assert e["reason"] == "STATICS_MAX_ITER"
+        assert e["resolved"] is True
+        assert e["status_after"] == health.OK
+        assert e["reason_after"] == "ok"
+        esc = e["escalation"]
+        assert esc["mode"] == "f64_cpu"
+        assert esc["rungs_tried"] == ["retol", "f64_cpu"]
+        assert esc["resolved_by"] == "f64_cpu"
+        assert esc["result_delta"]["X0"] is not None  # original-vs-escalated
+    # ladder order visible in the event log: retol fails, f64_cpu heals
+    esc_evs = _events(log_path, "shard_escalate")
+    assert [(e["rung"], e["resolved"]) for e in esc_evs] \
+        == [("retol", False), ("f64_cpu", True)] * 2
+    with open(os.path.join(out_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert all(manifest["shards"][str(s)]["flagged"] == 0 for s in (0, 1))
+    assert all(manifest["shards"][str(s)]["quarantined"] == 0
+               for s in (0, 1))
+    done = _events(log_path, "sweep_done")
+    assert done and done[-1]["n_flagged"] == 0 \
+        and done[-1]["n_quarantined"] == 0
+
+    # resume is quiet: escalated shards are valid on disk
+    out2 = run_sweep_checkpointed_full(
+        make_stiff_evaluator(), {"F0": CASES_F0}, out_dir, shard_size=2,
+        mesh=mesh2(), out_keys=("X0", "status"))
+    assert np.array_equal(np.asarray(out2["X0"]), X0)
+    assert len(resilience.load_quarantine(out_dir)) == 2
+
+
+def test_retol_rung_sufficient_when_budget_is_the_problem(tmp_path,
+                                                          monkeypatch):
+    """A case that is merely budget-starved (f64, max_iter too small)
+    is healed by the FIRST rung — f64_cpu is never tried."""
+
+    def evaluate(case):
+        rdt = compute_dtypes()[0]
+        K, force, stiff, tol, caps, refs = _toy_system(rdt)
+        F = jnp.stack([case["F0"], -case["F0"]]).astype(rdt)
+        X, Fres, n_iter, conv, st = solve_equilibrium_general(
+            K, F, jnp.zeros(2, rdt), force, stiff, tol, caps, refs,
+            max_iter=2)
+        return {"X0": X, "status": jnp.asarray(st, jnp.int32)}
+
+    monkeypatch.setenv("RAFT_TPU_ESCALATE", "retol")
+    out_dir = str(tmp_path / "sweep")
+    out = run_sweep_checkpointed_full(
+        evaluate, {"F0": CASES_F0[:2]}, out_dir, shard_size=2,
+        mesh=mesh2(), out_keys=("X0", "status"))
+    assert list(np.asarray(out["status"])) == [health.OK, health.OK]
+    (entry,) = resilience.load_quarantine(out_dir)
+    assert entry["escalation"]["resolved_by"] == "retol"
+    assert entry["escalation"]["rungs_tried"] == ["retol"]
+    np.testing.assert_allclose(np.asarray(out["X0"])[1],
+                               [4.7251313, -4.7251313], rtol=1e-6)
+
+
+def test_nan_rows_climb_the_ladder_too(tmp_path, monkeypatch):
+    """With escalation active, NaN rows take the ladder instead of the
+    legacy solo retry — and a deterministic pathology that persists
+    through every rung stays quarantined, original NaNs intact."""
+
+    def evaluate(case):
+        bad = case["F0"] < 0
+        x = jnp.where(bad, jnp.nan, case["F0"])
+        return {"X0": jnp.stack([x, -x]),
+                "status": jnp.zeros((), jnp.int32)}
+
+    monkeypatch.setenv("RAFT_TPU_ESCALATE", "f64_cpu")
+    out_dir = str(tmp_path / "sweep")
+    out = run_sweep_checkpointed_full(
+        evaluate, {"F0": np.asarray([1.0, -1.0])}, out_dir, shard_size=2,
+        mesh=mesh2(), out_keys=("X0", "status"))
+    assert np.isnan(np.asarray(out["X0"])[1]).all()
+    (entry,) = resilience.load_quarantine(out_dir)
+    assert entry["resolved"] is False
+    assert entry["status"] & health.NONFINITE_INTERMEDIATE
+    assert "NONFINITE_INTERMEDIATE" in entry["reason"]
+    assert entry["escalation"]["resolved_by"] is None
+    assert entry["escalation"]["rungs_tried"] == ["retol", "f64_cpu"]
+    assert entry["keys_nonfinite"] == ["X0"]
