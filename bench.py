@@ -820,18 +820,63 @@ def _finish_breakdown(breakdown, t_compile, dt, t_stat, t_dyn,
         batch_designs=batch_designs,
         distinct_geometries=distinct_geometries,
     )
+    breakdown["telemetry"] = _telemetry_block()
     return breakdown
+
+
+def _telemetry_block():
+    """Fold the obs metrics snapshot into the bench breakdown: total
+    XLA compiles (recompile-sentinel feed), sweep-runtime reliability
+    counters, and the heartbeat gauges' high watermarks — so the
+    BENCH_rNN.json artifact carries the telemetry trajectory alongside
+    the timings."""
+    from raft_tpu.obs import heartbeat as hb_mod
+    from raft_tpu.obs import metrics
+
+    if config.get("HEARTBEAT_S"):
+        # one synchronous sample so the block reflects the END of the
+        # run even when the bench finished inside the first interval
+        try:
+            hb_mod.Heartbeat(0.0).beat()
+        except Exception:
+            pass
+    snap = metrics.snapshot()
+    c, g = snap["counters"], snap["gauges"]
+
+    def gmax(name):
+        v = (g.get(name) or {}).get("max")
+        return int(v) if v is not None else None
+
+    return dict(
+        xla_compiles=c.get("xla_compiles", 0),
+        shard_retries=c.get("shard_retries", 0),
+        shard_oom_splits=c.get("shard_oom_splits", 0),
+        escalation_rungs=c.get("escalation_rungs", 0),
+        escalations_resolved=c.get("escalations_resolved", 0),
+        rows_quarantined=c.get("rows_quarantined", 0),
+        cases_flagged=c.get("cases_flagged", 0),
+        heartbeat_max_device_bytes=gmax("device_bytes_in_use"),
+        heartbeat_max_live_arrays=gmax("live_arrays"),
+    )
 
 
 def run_mode(mode):
     t_start = time.perf_counter()
     _enable_compile_cache()
-    import jax
-    import jax.numpy as jnp
+    from raft_tpu.obs.heartbeat import maybe_heartbeat
 
     if mode == "flat":
-        run_flat(t_start)
+        with maybe_heartbeat():
+            run_flat(t_start)
         return
+
+    with maybe_heartbeat():
+        _run_geom(t_start)
+
+
+def _run_geom(t_start):
+    import jax
+    import jax.numpy as jnp
 
     model, evaluate = build()
     n_cases = len(CASES)

@@ -34,6 +34,13 @@ codebase (or was fixed by hand in PR 2 and must stay fixed):
     without declaring ``static_argnames``/``static_argnums`` — traced
     config args either crash at trace time or recompile per value.
 
+``event-name``
+    ``log_event("<name>", ...)`` calls whose literal event name is not
+    registered in :mod:`raft_tpu.obs.events`: a typo'd name does not
+    crash anything, it silently splits an event stream in two and every
+    consumer (``python -m raft_tpu.obs report``/``trace``, quarantine
+    forensics) sees only half the story.
+
 Suppression: append ``# raft-lint: disable=<rule>[,<rule>]`` to the
 offending line (or put it alone on the line above); a file-level
 ``# raft-lint: disable-file=<rule>`` comment disables a rule for the
@@ -55,7 +62,25 @@ RULES = {
     "host-coercion": "host-Python coercion of a traced value",
     "env-read": "raw RAFT_TPU_* env read outside raft_tpu.utils.config",
     "jit-static": "jax.jit of config-like args without static_argnames",
+    "event-name": "log_event() with an unregistered event name",
 }
+
+_EVENT_NAMES = None
+
+
+def _event_names():
+    """Registered event names (lazy: the registry lives in
+    :mod:`raft_tpu.obs.events`, itself jax-free).  An unloadable
+    registry disables the rule rather than flagging everything."""
+    global _EVENT_NAMES
+    if _EVENT_NAMES is None:
+        try:
+            from raft_tpu.obs.events import EVENTS
+
+            _EVENT_NAMES = frozenset(EVENTS)
+        except Exception:
+            _EVENT_NAMES = frozenset()
+    return _EVENT_NAMES
 
 # modules whose code runs under jax tracing: the host-coercion rule
 # only applies here.  Host-orchestration modules (drivers, outputs,
@@ -231,6 +256,7 @@ class _Linter(ast.NodeVisitor):
         self._check_host_coercion(node)
         self._check_env_read(node)
         self._check_jit_static(node)
+        self._check_event_name(node)
         self.generic_visit(node)
 
     def visit_Subscript(self, node):
@@ -339,6 +365,27 @@ class _Linter(ast.NodeVisitor):
                 f"raw read of {key.value!r} outside the flag registry; "
                 "register it in raft_tpu/utils/config.py and use "
                 "config.get/config.raw")
+
+    def _check_event_name(self, node):
+        # log_event("name", ...) / structlog.log_event("name", ...);
+        # dynamic first args (stage's self.name) are not checkable
+        fn = node.func
+        is_log_event = ((isinstance(fn, ast.Name) and fn.id == "log_event")
+                        or (isinstance(fn, ast.Attribute)
+                            and fn.attr == "log_event"))
+        if not is_log_event or not node.args:
+            return
+        name = node.args[0]
+        if not (isinstance(name, ast.Constant)
+                and isinstance(name.value, str)):
+            return
+        registry = _event_names()
+        if registry and name.value not in registry:
+            self._emit(
+                "event-name", node,
+                f"log_event({name.value!r}): event name not registered "
+                "in raft_tpu/obs/events.py — a typo'd name silently "
+                "splits the event stream for every consumer")
 
     def _check_jit_static(self, node):
         if not (isinstance(node.func, ast.Attribute)

@@ -66,21 +66,37 @@ _registered = False
 
 def _listener(event, duration_secs, **kwargs):
     if event == COMPILE_EVENT:
+        # feed the telemetry registry unconditionally: total compile
+        # count + time are part of every metrics snapshot
+        # (raft_tpu.obs.metrics), not just of sentinel scopes
+        from raft_tpu.obs import metrics
+
+        metrics.counter("xla_compiles").inc()
+        metrics.histogram("xla_compile_s").observe(duration_secs)
         for log in _ACTIVE_LOGS:
             log.count += 1
             log.seconds.append(duration_secs)
 
 
-@contextlib.contextmanager
-def count_compilations():
-    """Context manager yielding a :class:`CompileLog` that counts every
-    XLA backend compilation inside the block (nesting-safe)."""
+def install():
+    """Register the process-wide compile listener (idempotent) so the
+    ``xla_compiles`` counter / ``xla_compile_s`` histogram count every
+    backend compilation from now on — called by
+    :func:`raft_tpu.utils.devices.enable_compile_cache`, i.e. by every
+    driver/sweep/bench entry point."""
     import jax.monitoring
 
     global _registered
     if not _registered:
         jax.monitoring.register_event_duration_secs_listener(_listener)
         _registered = True
+
+
+@contextlib.contextmanager
+def count_compilations():
+    """Context manager yielding a :class:`CompileLog` that counts every
+    XLA backend compilation inside the block (nesting-safe)."""
+    install()
     log = CompileLog()
     _ACTIVE_LOGS.append(log)
     try:

@@ -16,13 +16,15 @@ def run(input_file, save_csv=None):
 
     runRAFT equivalent: YAML -> Model -> analyze_cases (-> CSV)."""
     import raft_tpu
+    from raft_tpu.obs import span
     from raft_tpu.utils.devices import enable_compile_cache
 
     enable_compile_cache()
-    model = raft_tpu.Model(input_file)
-    model.analyze_cases()
-    if save_csv:
-        save_responses(model, save_csv)
+    with span("driver.run", input=str(input_file)):
+        model = raft_tpu.Model(input_file)
+        model.analyze_cases()
+        if save_csv:
+            save_responses(model, save_csv)
     return model
 
 
@@ -35,13 +37,15 @@ def run_farm(input_file, save_csv=None):
     case metrics only, no single-FOWT property/eigen outputs.
     Returns the Model."""
     import raft_tpu
+    from raft_tpu.obs import span
     from raft_tpu.utils.devices import enable_compile_cache
 
     enable_compile_cache()
-    model = raft_tpu.Model(input_file)
-    model.analyze_cases()
-    if save_csv:
-        save_responses(model, save_csv)
+    with span("driver.run_farm", input=str(input_file)):
+        model = raft_tpu.Model(input_file)
+        model.analyze_cases()
+        if save_csv:
+            save_responses(model, save_csv)
     return model
 
 

@@ -271,6 +271,7 @@ class Model:
             # reported equilibrium is the capped iterate
             import warnings
 
+            from raft_tpu.obs import metrics
             from raft_tpu.utils import health
             from raft_tpu.utils.structlog import log_event
 
@@ -278,6 +279,7 @@ class Model:
                 "solveStatics Newton did not converge within "
                 f"{int(n_iter)} iterations "
                 f"(status: {health.describe(int(st_status))})")
+            metrics.counter("statics_unconverged").inc()
             log_event("statics_unconverged", n_iter=int(n_iter),
                       status=int(st_status),
                       reason=health.describe(int(st_status)))
@@ -919,24 +921,32 @@ class Model:
             "case_metrics": {},
             "mean_offsets": [],
         }
-        from raft_tpu.utils.structlog import log_event, stage
+        from raft_tpu.obs import metrics, span
+        from raft_tpu.utils.structlog import log_event
 
         for iCase, case in enumerate(self.cases):
             self._current_case_index = iCase   # QTF checkpoint filenames
-            with stage("solve_statics", case=iCase):
+            # telemetry spans (host-side only): statics + dynamics wall
+            # times per case land in the span tree / span_*_s histograms
+            with span("solve_statics", case=iCase):
                 X0 = self.solve_statics(case)
-            with stage("solve_dynamics", case=iCase):
+            with span("solve_dynamics", case=iCase):
                 Xi, info = self.solve_dynamics(case, X0=X0)
+            metrics.counter("cases_done").inc()
             for i, inf in enumerate(info.get("infos", [])):
                 dd = inf.get("dyn_diag")
                 if dd is not None:
                     from raft_tpu.utils import health
+                    st = int(dd["status"])
+                    metrics.histogram("drag_iterations").observe(
+                        int(dd["n_iter_drag"]))
+                    if st & int(health.SEVERE):
+                        metrics.counter("cases_flagged").inc()
                     log_event("drag_linearisation", case=iCase, fowt=i,
                               resid=float(dd["drag_resid"]),
                               converged=bool(dd["drag_converged"]),
                               n_iter=int(dd["n_iter_drag"]),
-                              status=int(dd["status"]),
-                              reason=health.describe(int(dd["status"])))
+                              status=st, reason=health.describe(st))
             # feed mean drift back into the equilibrium for ANY 2nd-order
             # configuration — the reference re-runs solveStatics with
             # Fhydro_2nd_mean whenever potSecOrder > 0, slender-body QTFs

@@ -1,0 +1,35 @@
+"""Telemetry subsystem: spans, metrics, heartbeats, trace tooling.
+
+SURVEY §5.1/§5.5: the reference's only instrumentation is a wall-clock
+print around the QTF loop.  The PR-1..4 runtime (retries, quarantine,
+escalation, recompile sentinel) emits flat JSONL events; this package
+turns that stream into first-class telemetry:
+
+* :mod:`raft_tpu.obs.spans` — hierarchical, contextvar-propagated
+  spans (``trace_id``/``span_id``/``parent_id``) around the drivers,
+  statics/dynamics solves, sweep shards, retry attempts and escalation
+  rungs, with ``jax.profiler.TraceAnnotation`` mirrors under
+  ``RAFT_TPU_PROFILE`` so host spans line up with device traces;
+* :mod:`raft_tpu.obs.metrics` — a process-wide thread-safe registry
+  (counters/gauges/log-bucket histograms) fed by the existing event
+  sites, snapshotted into the sweep manifest + ``metrics.json`` and
+  exportable as Prometheus text (``RAFT_TPU_METRICS``);
+* :mod:`raft_tpu.obs.heartbeat` — an optional device sampler thread
+  (``RAFT_TPU_HEARTBEAT_S``) for OOM forensics;
+* :mod:`raft_tpu.obs.events` — the lint-enforced registry of every
+  event name (``event-name`` rule);
+* :mod:`raft_tpu.obs.report` — ``python -m raft_tpu.obs report`` and
+  ``... trace`` (Chrome/Perfetto export) over captured JSONL.
+
+All instrumentation is host-side only: nothing here runs under a jax
+trace, the jaxpr primitive baseline is unchanged, and with
+``RAFT_TPU_LOG`` unset a span costs a few microseconds (sink check +
+clock read + histogram observe).  This module
+imports no jax (the report/trace/events CLIs and the linter load it
+backend-free); jax access inside heartbeat/spans is lazy and gated.
+"""
+
+from raft_tpu.obs import events, metrics  # noqa: F401
+from raft_tpu.obs.heartbeat import Heartbeat, maybe_heartbeat  # noqa: F401
+from raft_tpu.obs.spans import current_ids, span  # noqa: F401
+from raft_tpu.utils.structlog import run_id  # noqa: F401

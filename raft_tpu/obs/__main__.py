@@ -1,0 +1,90 @@
+"""CLI for the telemetry subsystem (pure stdlib, no jax).
+
+    python -m raft_tpu.obs report <run.jsonl>
+    python -m raft_tpu.obs trace  <run.jsonl> -o trace.json
+    python -m raft_tpu.obs events
+
+``report`` prints the per-stage wall-time tree, counter table and
+reliability summary of one ``RAFT_TPU_LOG`` capture; ``trace`` exports
+it as Chrome/Perfetto trace-event JSON (load in ``chrome://tracing``
+or https://ui.perfetto.dev); ``events`` lists the registered event
+schema.  Exit codes: 0 ok, 2 usage/input error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load(path):
+    from raft_tpu.obs import report
+
+    try:
+        events, bad = report.read_events(path)
+    except OSError as e:
+        print(f"cannot read {path}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    if not events:
+        print(f"{path}: no parseable events (was RAFT_TPU_LOG pointed "
+              "here during the run?)", file=sys.stderr)
+        raise SystemExit(2)
+    return events, bad
+
+
+def _cmd_report(args):
+    from raft_tpu.obs import report
+
+    events, bad = _load(args.jsonl)
+    sys.stdout.write(report.render_report(events, bad, source=args.jsonl))
+    return 0
+
+
+def _cmd_trace(args):
+    from raft_tpu.obs import report
+
+    events, bad = _load(args.jsonl)
+    trace = report.chrome_trace(events)
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    meta = trace["otherData"]
+    print(f"{args.output}: {len(trace['traceEvents'])} trace events "
+          f"({meta['spans_matched']} spans"
+          + (f", {meta['spans_unmatched']} unmatched" if
+             meta["spans_unmatched"] else "")
+          + (f"; {bad} unparseable lines skipped" if bad else "")
+          + ") — open in chrome://tracing or ui.perfetto.dev")
+    return 0
+
+
+def _cmd_events(_args):
+    from raft_tpu.obs import events as ev
+
+    for name, fields, help_ in ev.describe():
+        print(f"{name:32s} {', '.join(fields):56s} {help_}")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m raft_tpu.obs")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("report", help="summarize one RAFT_TPU_LOG capture")
+    p.add_argument("jsonl", help="path to the captured JSONL event stream")
+
+    p = sub.add_parser("trace",
+                       help="export a capture as Chrome trace events")
+    p.add_argument("jsonl", help="path to the captured JSONL event stream")
+    p.add_argument("-o", "--output", default="trace.json",
+                   help="output path (default trace.json)")
+
+    sub.add_parser("events", help="list the registered event schema")
+
+    args = ap.parse_args(argv)
+    return {"report": _cmd_report, "trace": _cmd_trace,
+            "events": _cmd_events}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+"""Registry of every structured-log event name the framework emits.
+
+A typo'd event name does not crash anything — it silently splits an
+event stream in two, and every consumer (the report CLI, the Chrome
+trace exporter, a grep) sees only half the story.  This module is the
+single source of truth: every ``log_event("<name>", ...)`` call site in
+the package must use a name registered here, enforced by the
+``event-name`` rule of the trace-hygiene linter
+(:mod:`raft_tpu.analysis.lint`) and gated by ``lint.sh``.
+
+Each entry maps the event name to its schema: the payload fields the
+emitter promises (beyond the universal stamps ``t``/``event``/``pid``/
+``run_id`` and, inside a span, ``trace_id``/``span_id`` — see
+:mod:`raft_tpu.utils.structlog`) and a one-line description.  The
+README "Observability" event table renders from :func:`describe`.
+
+Pure stdlib — the linter and the report/trace CLIs import this without
+touching a jax backend.
+"""
+
+from __future__ import annotations
+
+#: name -> (fields tuple, help).  Fields are the emitter's documented
+#: payload keys; optional keys are suffixed with ``?``.
+EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
+    # ------------------------------------------------------------ telemetry
+    "span_begin": (
+        ("name", "parent_id"),
+        "a telemetry span opened (obs.span); attrs ride along verbatim"),
+    "span_end": (
+        ("name", "wall_s", "ok", "error?"),
+        "the matching span closed; error carries repr(exc) on failure"),
+    "heartbeat": (
+        ("devices", "live_arrays", "progress?"),
+        "periodic device sampler: per-device memory_stats, live-buffer "
+        "count, sweep shard progress (RAFT_TPU_HEARTBEAT_S)"),
+    "metrics_snapshot": (
+        ("snapshot",),
+        "full metrics-registry snapshot (emitted at sweep_done; also "
+        "written to <out_dir>/metrics.json)"),
+    "profile_start": (
+        ("dir",),
+        "jax profiler capture started for a checkpointed sweep "
+        "(RAFT_TPU_PROFILE)"),
+    "profile_stop": (("dir",), "jax profiler capture finished"),
+    "profile_failed": (
+        ("error",),
+        "jax profiler capture could not start/stop (logged, not fatal)"),
+    # -------------------------------------------------------- sweep runtime
+    "sweep_start": (
+        ("out_dir", "n_cases", "n_shards", "shard_size", "out_keys",
+         "mesh_shape"),
+        "checkpointed sweep began"),
+    "sweep_done": (
+        ("out_dir", "n_cases", "n_quarantined", "n_flagged", "wall_s"),
+        "checkpointed sweep finished"),
+    "shard_start": (("shard", "rows"), "shard evaluation began"),
+    "shard_done": (("shard", "rows", "wall_s"), "shard written"),
+    "shard_resume": (
+        ("shard", "rows"), "shard loaded from a valid checkpoint file"),
+    "shard_corrupt": (
+        ("shard", "error"),
+        "checkpoint shard failed validation and was re-queued"),
+    "shard_retry": (
+        ("shard", "attempt", "max_retries", "delay_s", "error"),
+        "transient shard failure; retrying with backoff"),
+    "shard_oom_split": (
+        ("shard", "rows", "split", "error"),
+        "device OOM; shard batch halved and re-evaluated"),
+    "shard_quarantine": (
+        ("shard", "index", "keys", "recovered", "status", "reason"),
+        "a non-finite or status-flagged row was judged"),
+    "shard_quarantine_retry_failed": (
+        ("shard", "index", "error"),
+        "the solo CPU re-evaluation of a quarantined row raised"),
+    "shard_escalate": (
+        ("shard", "index", "rung", "status_before", "status_after",
+         "resolved"),
+        "one escalation-ladder rung re-solved a flagged row"),
+    "shard_escalate_failed": (
+        ("shard", "index", "rung", "error"),
+        "an escalation rung raised instead of returning a result"),
+    "backend_fallback": (
+        ("from_platform", "to_platform", "forced_by_fault"),
+        "accelerator unhealthy; sweep pinned to the CPU backend"),
+    "backend_fallback_failed": (
+        ("from_platform", "reason"),
+        "CPU pin attempted after a backend was already initialized"),
+    "manifest_mismatch": (
+        ("out_dir", "fields", "fatal"),
+        "resume fingerprint differs from manifest.json"),
+    "quarantine_corrupt": (
+        ("out_dir", "error"),
+        "quarantine.json was unreadable (externally damaged)"),
+    # ------------------------------------------------------------- solvers
+    "statics_unconverged": (
+        ("n_iter", "status", "reason"),
+        "statics Newton hit its budget with the step rule unmet"),
+    "drag_linearisation": (
+        ("case", "fowt", "resid", "converged", "n_iter", "status",
+         "reason"),
+        "per-case drag-linearisation convergence diagnostics"),
+    # ---------------------------------------------------------- sweep trace
+    "sweep_program_built": (
+        ("kind", "out_keys"),
+        "a sweep jit wrapper was built fresh (first call for this memo "
+        "key; the next dispatch traces + compiles)"),
+}
+
+
+def is_registered(name):
+    return name in EVENTS
+
+
+def describe():
+    """Yield ``(name, fields, help)`` rows sorted by name (the README
+    event table and ``python -m raft_tpu.obs events`` render from
+    this)."""
+    for name in sorted(EVENTS):
+        fields, help_ = EVENTS[name]
+        yield name, fields, help_

@@ -1,0 +1,139 @@
+"""Periodic device heartbeats for OOM forensics and liveness.
+
+A sweep that dies of device OOM (or silently slows down as live
+buffers pile up) is much easier to debug when the event log carries
+the memory picture that *preceded* the failure: quarantined rows and
+``shard_oom_split`` events then have heartbeats around them showing
+per-device ``memory_stats()``, the live-buffer count and how far the
+sweep had progressed.
+
+Enable with ``RAFT_TPU_HEARTBEAT_S=<seconds>`` (0 disables — the
+default).  The sampler is one daemon thread emitting ``heartbeat``
+JSONL events (the structlog sink is lock-protected for exactly this
+reason) and updating the ``device_bytes_in_use`` /
+``device_peak_bytes_in_use`` / ``live_arrays`` gauges, whose high
+watermarks survive into the metrics snapshot (``heartbeat`` block of
+the bench breakdown).
+
+On backends without allocator stats (the CPU backend returns ``None``
+from ``memory_stats()``) the heartbeat still reports the live-buffer
+count and shard progress.  Sampling must never take down the run: all
+jax access is wrapped, and failures are reported in-band on the event.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from raft_tpu.obs import metrics
+from raft_tpu.utils import config
+from raft_tpu.utils.structlog import log_event
+
+_MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+             "largest_free_block_bytes")
+
+
+def sample_devices(devices=None):
+    """One host-side sample: per-device memory stats + live-buffer
+    count.  Returns ``(device_rows, live_arrays)``; safe to call from
+    any thread once a backend is initialized."""
+    import jax
+
+    rows = []
+    devs = devices if devices is not None else jax.devices()
+    for d in devs:
+        row = {"id": getattr(d, "id", None),
+               "kind": getattr(d, "device_kind", "?")}
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            for k in _MEM_KEYS:
+                if k in stats:
+                    row[k] = int(stats[k])
+        rows.append(row)
+    try:
+        live = len(jax.live_arrays())
+    except Exception:
+        live = None
+    return rows, live
+
+
+class Heartbeat(threading.Thread):
+    """Daemon sampler thread (use :func:`maybe_heartbeat` to gate on
+    the flag).  ``progress`` is a plain dict the owner mutates in
+    place (e.g. ``{"shards_done": 3, "n_shards": 20}``); each beat
+    snapshots it, so the heartbeat stream doubles as a liveness probe
+    for the sweep itself."""
+
+    def __init__(self, interval_s, devices=None, progress=None):
+        super().__init__(name="raft-tpu-heartbeat", daemon=True)
+        self.interval_s = float(interval_s)
+        self.devices = list(devices) if devices is not None else None
+        self.progress = progress
+        self.beats = 0
+        # NB: not `_stop` — threading.Thread uses that name internally
+        self._stop_evt = threading.Event()
+        self._final_done = False
+
+    def beat(self):
+        try:
+            rows, live = sample_devices(self.devices)
+        except Exception as e:  # backend gone mid-run: report, don't die
+            log_event("heartbeat", devices=[], live_arrays=None,
+                      error=str(e)[:200])
+            return
+        in_use = [r["bytes_in_use"] for r in rows if "bytes_in_use" in r]
+        peak = [r["peak_bytes_in_use"] for r in rows
+                if "peak_bytes_in_use" in r]
+        if in_use:
+            metrics.gauge("device_bytes_in_use").set(max(in_use))
+        if peak:
+            metrics.gauge("device_peak_bytes_in_use").set(max(peak))
+        if live is not None:
+            metrics.gauge("live_arrays").set(live)
+        kw = {}
+        if self.progress:
+            kw["progress"] = dict(self.progress)
+        log_event("heartbeat", devices=rows, live_arrays=live, **kw)
+        self.beats += 1
+
+    def run(self):
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self.beat()
+            except Exception:
+                # the sampler must outlive any single bad sample (beat
+                # already reports failures in-band where it can)
+                pass
+
+    def stop(self, final_beat=True):
+        """Stop the sampler; by default take one last beat so the log
+        (and the gauges' watermarks) end with the terminal memory
+        picture.  Idempotent — the sweep runner stops the heartbeat
+        explicitly before snapshotting metrics, and the context exit
+        calling again is a no-op."""
+        self._stop_evt.set()
+        if self.is_alive():
+            self.join(timeout=max(2.0, 2 * self.interval_s))
+        if final_beat and not self._final_done:
+            self._final_done = True
+            self.beat()
+
+
+@contextlib.contextmanager
+def maybe_heartbeat(devices=None, progress=None):
+    """Start a :class:`Heartbeat` for the block when
+    ``RAFT_TPU_HEARTBEAT_S`` > 0, else yield ``None`` at zero cost."""
+    interval = config.get("HEARTBEAT_S")
+    if not interval or interval <= 0:
+        yield None
+        return
+    hb = Heartbeat(interval, devices=devices, progress=progress)
+    hb.start()
+    try:
+        yield hb
+    finally:
+        hb.stop()

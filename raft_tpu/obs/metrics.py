@@ -1,0 +1,259 @@
+"""Process-wide, thread-safe metrics registry.
+
+Counters, gauges and fixed-bucket histograms fed by the runtime's
+existing event sites (shards done/retried/quarantined/escalated, XLA
+backend compiles via the recompilation sentinel, drag-linearisation
+iteration counts, solver-health flags, span wall times).  Unlike the
+JSONL event stream — which answers "what happened, in order" — the
+registry answers "how much, in total" without re-reading anything:
+``snapshot()`` is dumped into the sweep manifest and
+``<out_dir>/metrics.json`` at ``sweep_done``, the bench folds it into
+its breakdown, and :func:`to_prometheus` renders the standard
+text-exposition format for scraping long runs
+(``RAFT_TPU_METRICS=<path>``).
+
+Pure stdlib, no jax import.  Metric updates are a lock + int/float op:
+cheap enough to stay on unconditionally (they fire per shard / per
+retry / per case, never per frequency bin), so telemetry totals exist
+even when the ``RAFT_TPU_LOG`` event stream is off.
+
+Histogram buckets are fixed and log-spaced (4 per decade over
+1e-6..1e7, covering microsecond spans to ~100-day walls and iteration
+counts alike) so snapshots from different processes are mergeable and
+the p50/p95 estimates are stable.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+
+_T0 = time.perf_counter()
+
+# fixed log-spaced bucket upper bounds: 10^(-6) .. 10^7, 4 per decade
+BUCKET_BOUNDS = tuple(10.0 ** (e / 4.0) for e in range(-24, 29))
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+
+class Gauge:
+    """Last-value gauge with a high watermark (heartbeat memory peaks
+    survive in ``max`` even after the gauge drops back)."""
+
+    __slots__ = ("name", "_lock", "_value", "_max")
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = None
+        self._max = None
+
+    def set(self, v):
+        v = float(v)
+        with self._lock:
+            self._value = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def max(self):
+        return self._max
+
+    def snapshot(self):
+        return {"value": self._value, "max": self._max}
+
+
+class Histogram:
+    """Fixed log-spaced-bucket histogram with count/sum/min/max and
+    bucket-interpolated percentile estimates."""
+
+    __slots__ = ("name", "_lock", "count", "sum", "min", "max", "_buckets")
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        # len(BUCKET_BOUNDS) + 1: trailing overflow bucket (+inf)
+        self._buckets = [0] * (len(BUCKET_BOUNDS) + 1)
+
+    def observe(self, v):
+        v = float(v)
+        i = bisect.bisect_left(BUCKET_BOUNDS, v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            self._buckets[i] += 1
+
+    def percentile(self, p):
+        """Estimated p-quantile (0..1) from the bucket counts: the
+        upper bound of the bucket where the cumulative count crosses
+        ``p * count``, clamped to the observed min/max."""
+        with self._lock:
+            if not self.count:
+                return None
+            target = p * self.count
+            acc = 0
+            for i, n in enumerate(self._buckets):
+                acc += n
+                if acc >= target and n:
+                    hi = (BUCKET_BOUNDS[i] if i < len(BUCKET_BOUNDS)
+                          else self.max)
+                    return float(min(max(hi, self.min), self.max))
+            return float(self.max)
+
+    def snapshot(self):
+        with self._lock:
+            if not self.count:
+                return {"count": 0}
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "mean": round(self.sum / self.count, 6),
+            "min": round(self.min, 6),
+            "max": round(self.max, 6),
+            "p50": round(self.percentile(0.50), 6),
+            "p95": round(self.percentile(0.95), 6),
+        }
+
+    def buckets(self):
+        """(upper_bound, cumulative_count) pairs for the Prometheus
+        exporter (only buckets up to the last non-empty one, plus
+        +Inf)."""
+        with self._lock:
+            counts = list(self._buckets)
+        out, acc = [], 0
+        for bound, n in zip(BUCKET_BOUNDS, counts):
+            acc += n
+            out.append((bound, acc))
+        return out
+
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: dict[str, object] = {}
+
+
+def _get(name, cls):
+    with _REGISTRY_LOCK:
+        m = _REGISTRY.get(name)
+        if m is None:
+            m = _REGISTRY[name] = cls(name)
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(m).__name__}, requested {cls.__name__}")
+        return m
+
+
+def counter(name) -> Counter:
+    return _get(name, Counter)
+
+
+def gauge(name) -> Gauge:
+    return _get(name, Gauge)
+
+
+def histogram(name) -> Histogram:
+    return _get(name, Histogram)
+
+
+def reset():
+    """Drop every registered metric (tests; also lets one process run
+    independent sweeps with per-sweep snapshots)."""
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()
+
+
+def snapshot():
+    """JSON-ready snapshot of the whole registry, grouped by metric
+    kind.  This is what lands in ``metrics.json``, the sweep manifest
+    and the bench breakdown."""
+    with _REGISTRY_LOCK:
+        items = sorted(_REGISTRY.items())
+    out = {"uptime_s": round(time.perf_counter() - _T0, 3),
+           "counters": {}, "gauges": {}, "histograms": {}}
+    for name, m in items:
+        kind = {Counter: "counters", Gauge: "gauges",
+                Histogram: "histograms"}[type(m)]
+        out[kind][name] = m.snapshot()
+    return out
+
+
+def _prom_name(name):
+    return "raft_tpu_" + "".join(
+        c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def to_prometheus():
+    """Render the registry in the Prometheus text exposition format
+    (counters/gauges as single samples, histograms as the standard
+    ``_bucket``/``_sum``/``_count`` family)."""
+    with _REGISTRY_LOCK:
+        items = sorted(_REGISTRY.items())
+    lines = []
+    for name, m in items:
+        pn = _prom_name(name)
+        if isinstance(m, Counter):
+            lines.append(f"# TYPE {pn} counter")
+            lines.append(f"{pn} {m.value}")
+        elif isinstance(m, Gauge):
+            if m.value is None:
+                continue
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {m.value}")
+            lines.append(f"{pn}_max {m.max}")
+        else:
+            lines.append(f"# TYPE {pn} histogram")
+            last_nonzero = 0
+            pairs = m.buckets()
+            for i, (_, acc) in enumerate(pairs):
+                if acc != (pairs[i - 1][1] if i else 0):
+                    last_nonzero = i
+            for bound, acc in pairs[: last_nonzero + 1]:
+                lines.append(f'{pn}_bucket{{le="{bound:.6g}"}} {acc}')
+            lines.append(f'{pn}_bucket{{le="+Inf"}} {m.count}')
+            lines.append(f"{pn}_sum {m.sum}")
+            lines.append(f"{pn}_count {m.count}")
+    return "\n".join(lines) + "\n"
+
+
+def export(path):
+    """Write :func:`to_prometheus` to ``path`` (best-effort: exporting
+    metrics must never take down the run that produced them)."""
+    try:
+        with open(path, "w") as f:
+            f.write(to_prometheus())
+        return True
+    except OSError:
+        return False

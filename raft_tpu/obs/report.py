@@ -1,0 +1,331 @@
+"""Offline tooling over captured telemetry JSONL streams.
+
+Two consumers of a ``RAFT_TPU_LOG`` capture (pure stdlib, no jax):
+
+* :func:`render_report` — the ``python -m raft_tpu.obs report`` view:
+  per-stage wall-time tree built from the span hierarchy (count /
+  total / p50 / p95), the counter table from the run's final metrics
+  snapshot, per-event-name counts, and a reliability summary
+  (retries, OOM splits, quarantine/escalation outcomes) — i.e. "where
+  did the sweep spend its time and what fraction was retried /
+  flagged / escalated" without re-running anything.
+* :func:`chrome_trace` — the ``python -m raft_tpu.obs trace`` export:
+  Chrome/Perfetto trace-event JSON (``chrome://tracing`` /
+  https://ui.perfetto.dev) with one complete ("X") slice per matched
+  span pair, instant events for everything else, and counter tracks
+  from the heartbeat stream's device-memory samples.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def read_events(path):
+    """Parse one JSONL capture; returns ``(events, n_bad_lines)``.
+    Damaged lines (a process killed mid-write pre-dates the sink lock)
+    are counted, not fatal."""
+    events, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if isinstance(ev, dict) and "event" in ev:
+                events.append(ev)
+            else:
+                bad += 1
+    return events, bad
+
+
+def collect_spans(events):
+    """Match ``span_begin``/``span_end`` pairs by span id.
+
+    Returns ``(spans, unmatched_begins)``; each span dict carries
+    name/t0/t1/wall_s/ok/ids/attrs.  Ends without a begin are dropped
+    (a capture that starts mid-run)."""
+    begins = {}
+    spans = []
+    for ev in events:
+        kind = ev["event"]
+        if kind == "span_begin" and "span_id" in ev:
+            begins[ev["span_id"]] = ev
+        elif kind == "span_end" and ev.get("span_id") in begins:
+            b = begins.pop(ev["span_id"])
+            attrs = {k: v for k, v in b.items()
+                     if k not in ("t", "event", "pid", "run_id", "trace_id",
+                                  "span_id", "name", "parent_id")}
+            spans.append({
+                "name": b.get("name", "?"),
+                "t0": b["t"], "t1": ev["t"],
+                "wall_s": ev.get("wall_s", round(ev["t"] - b["t"], 6)),
+                "ok": ev.get("ok", True),
+                "error": ev.get("error"),
+                "span_id": b["span_id"],
+                "parent_id": b.get("parent_id"),
+                "trace_id": b.get("trace_id"),
+                "pid": b.get("pid"),
+                "run_id": b.get("run_id"),
+                "attrs": attrs,
+            })
+    return spans, list(begins.values())
+
+
+def _percentile(vals, p):
+    vals = sorted(vals)
+    if not vals:
+        return None
+    i = min(len(vals) - 1, max(0, round(p * (len(vals) - 1))))
+    return vals[i]
+
+
+def span_paths(spans):
+    """Aggregate spans by their name *path* (root→leaf names following
+    parent ids).  Returns ``{path_tuple: [wall_s, ...]}`` plus the
+    per-path failure counts."""
+    by_id = {s["span_id"]: s for s in spans}
+    paths = {}
+    fails = {}
+
+    def path_of(s, _depth=0):
+        if s["parent_id"] and s["parent_id"] in by_id and _depth < 64:
+            return path_of(by_id[s["parent_id"]], _depth + 1) + (s["name"],)
+        return (s["name"],)
+
+    for s in spans:
+        p = path_of(s)
+        paths.setdefault(p, []).append(s["wall_s"])
+        if not s.get("ok", True):
+            fails[p] = fails.get(p, 0) + 1
+    return paths, fails
+
+
+def _fmt_s(v):
+    return f"{v:9.3f}s" if v is not None else "        —"
+
+
+def render_report(events, n_bad=0, source="<events>"):
+    """Human-readable report (string) over one capture."""
+    out = []
+    run_ids = sorted({e.get("run_id") for e in events if e.get("run_id")})
+    # per-pid windows summed: `t` is monotonic per process, so a
+    # resume-appended capture spans several clocks
+    pids = {}
+    for e in events:
+        lo, hi = pids.get(e.get("pid") or 1, (e["t"], e["t"]))
+        pids[e.get("pid") or 1] = (min(lo, e["t"]), max(hi, e["t"]))
+    window = sum(hi - lo for lo, hi in pids.values())
+    out.append(f"telemetry report — {source}")
+    out.append(f"  {len(events)} events"
+               + (f" ({n_bad} unparseable lines skipped)" if n_bad else "")
+               + f", window {window:.3f}s"
+               + (f" across {len(pids)} process(es)" if len(pids) > 1 else "")
+               + f", run_id(s): {', '.join(run_ids) or '—'}")
+
+    spans, unmatched = collect_spans(events)
+    if spans or unmatched:
+        out.append("")
+        out.append("span wall-time tree"
+                   + (f"  [{len(unmatched)} unmatched begin(s) — "
+                      "process died mid-span]" if unmatched else ""))
+        out.append(f"  {'':38s} {'count':>6s} {'total':>10s} "
+                   f"{'p50':>10s} {'p95':>10s} {'max':>10s}")
+        paths, fails = span_paths(spans)
+        # plain tuple sort = depth-first tree order (a child path sorts
+        # immediately after its parent prefix)
+        for p in sorted(paths):
+            walls = paths[p]
+            label = "  " * (len(p) - 1) + p[-1]
+            nfail = fails.get(p, 0)
+            out.append(
+                f"  {label:38s} {len(walls):6d} {_fmt_s(sum(walls))} "
+                f"{_fmt_s(_percentile(walls, 0.50))} "
+                f"{_fmt_s(_percentile(walls, 0.95))} "
+                f"{_fmt_s(max(walls))}"
+                + (f"   [{nfail} failed]" if nfail else ""))
+
+    # legacy flat stage timings (structlog.stage emits the stage name
+    # as the event, with wall_s)
+    legacy = {}
+    for e in events:
+        if "wall_s" in e and e["event"] not in (
+                "span_end", "shard_done", "sweep_done"):
+            legacy.setdefault(e["event"], []).append(e["wall_s"])
+    if legacy:
+        out.append("")
+        out.append("flat stage timings (structlog.stage)")
+        for name, walls in sorted(legacy.items()):
+            out.append(
+                f"  {name:38s} {len(walls):6d} {_fmt_s(sum(walls))} "
+                f"{_fmt_s(_percentile(walls, 0.50))} "
+                f"{_fmt_s(_percentile(walls, 0.95))} "
+                f"{_fmt_s(max(walls))}")
+
+    snaps = [e for e in events if e["event"] == "metrics_snapshot"]
+    if snaps:
+        snap = snaps[-1].get("snapshot", {})
+        counters = snap.get("counters", {})
+        if counters:
+            out.append("")
+            out.append("counters (final metrics snapshot)")
+            for name, v in sorted(counters.items()):
+                out.append(f"  {name:38s} {v}")
+        gauges = snap.get("gauges", {})
+        if gauges:
+            out.append("")
+            out.append("gauges (value / high watermark)")
+            for name, g in sorted(gauges.items()):
+                out.append(f"  {name:38s} {g.get('value')} / {g.get('max')}")
+        hists = {k: h for k, h in snap.get("histograms", {}).items()
+                 if h.get("count")}
+        if hists:
+            out.append("")
+            out.append("histograms (count / mean / p50 / p95 / max)")
+            for name, h in sorted(hists.items()):
+                out.append(
+                    f"  {name:38s} {h['count']:6d}  {h.get('mean')}  "
+                    f"{h.get('p50')}  {h.get('p95')}  {h.get('max')}")
+
+    counts = {}
+    for e in events:
+        counts[e["event"]] = counts.get(e["event"], 0) + 1
+    out.append("")
+    out.append("event counts")
+    for name, n in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])):
+        out.append(f"  {name:38s} {n:6d}")
+
+    # reliability summary: the "what fraction was retried/flagged/
+    # escalated" question, straight from the event stream
+    retries = [e for e in events if e["event"] == "shard_retry"]
+    ooms = [e for e in events if e["event"] == "shard_oom_split"]
+    quar = [e for e in events if e["event"] == "shard_quarantine"]
+    esc = [e for e in events if e["event"] == "shard_escalate"]
+    done = [e for e in events if e["event"] == "sweep_done"]
+    if retries or ooms or quar or esc or done:
+        out.append("")
+        out.append("reliability summary")
+        if retries:
+            out.append(f"  retries: {len(retries)} "
+                       f"(shards {sorted({e.get('shard') for e in retries})})")
+        if ooms:
+            out.append(f"  oom splits: {len(ooms)}")
+        if quar:
+            rec = sum(1 for e in quar if e.get("recovered"))
+            out.append(f"  quarantine judgements: {len(quar)} "
+                       f"({rec} recovered, {len(quar) - rec} kept bad)")
+            reasons = {}
+            for e in quar:
+                r = str(e.get("reason") or "?")
+                reasons[r] = reasons.get(r, 0) + 1
+            for r, n in sorted(reasons.items(), key=lambda kv: -kv[1]):
+                out.append(f"    reason {r}: {n}")
+        if esc:
+            res = sum(1 for e in esc if e.get("resolved"))
+            out.append(f"  escalation rungs: {len(esc)} ({res} resolved)")
+        for e in done:
+            out.append(
+                f"  sweep_done: {e.get('n_cases')} cases, "
+                f"{e.get('n_quarantined')} quarantined, "
+                f"{e.get('n_flagged')} flagged, wall {e.get('wall_s')}s")
+    return "\n".join(out) + "\n"
+
+
+# ----------------------------------------------------------- chrome trace
+
+
+def _pid_time_offsets(events):
+    """Per-pid timestamp offsets: ``t`` is monotonic within ONE
+    process, so a capture appended across a resume (pinned
+    ``RAFT_TPU_RUN_ID``) holds several pids whose clocks all start
+    near zero.  Lay the processes out sequentially in file order (the
+    real-world ordering of an append-mode capture) with a 1 ms gap."""
+    bounds, order = {}, []
+    for ev in events:
+        pid = ev.get("pid") or 1
+        b = bounds.get(pid)
+        if b is None:
+            bounds[pid] = [ev["t"], ev["t"]]
+            order.append(pid)
+        else:
+            b[0] = min(b[0], ev["t"])
+            b[1] = max(b[1], ev["t"])
+    offsets, cursor = {}, 0.0
+    for pid in order:
+        lo, hi = bounds[pid]
+        offsets[pid] = cursor - lo
+        cursor += (hi - lo) + 1e-3
+    return offsets
+
+
+def chrome_trace(events):
+    """Chrome trace-event JSON (dict with ``traceEvents``) from one
+    capture: matched spans as complete "X" slices, other events as
+    instants, heartbeat memory samples as counter tracks.  Multi-pid
+    captures (resume appends) render sequentially, one process track
+    after the other."""
+    spans, unmatched = collect_spans(events)
+    offsets = _pid_time_offsets(events)
+    tids = {}
+
+    def tid_for(trace_id):
+        if trace_id not in tids:
+            tids[trace_id] = len(tids) + 1
+        return tids[trace_id]
+
+    def ts_of(t, pid):
+        return round((t + offsets.get(pid or 1, 0.0)) * 1e6, 1)
+
+    trace = []
+    span_ids = set()
+    for s in spans:
+        span_ids.add(s["span_id"])
+        args = dict(s["attrs"])
+        args["span_id"] = s["span_id"]
+        if s["parent_id"]:
+            args["parent_id"] = s["parent_id"]
+        if s["error"]:
+            args["error"] = s["error"]
+        trace.append({
+            "name": s["name"], "cat": "span", "ph": "X",
+            "ts": ts_of(s["t0"], s.get("pid")),
+            "dur": round(max(s["t1"] - s["t0"], s["wall_s"] or 0.0) * 1e6, 1),
+            "pid": s.get("pid") or 1,
+            "tid": tid_for(s.get("trace_id")),
+            "args": args,
+        })
+    for ev in events:
+        kind = ev["event"]
+        if kind in ("span_begin", "span_end"):
+            continue
+        pid = ev.get("pid") or 1
+        tid = tid_for(ev.get("trace_id")) if ev.get("trace_id") else 0
+        ts = ts_of(ev["t"], pid)
+        if kind == "heartbeat":
+            for d in ev.get("devices") or []:
+                if "bytes_in_use" in d:
+                    trace.append({
+                        "name": f"device{d.get('id')} memory", "ph": "C",
+                        "ts": ts, "pid": pid, "tid": 0,
+                        "args": {"bytes_in_use": d["bytes_in_use"]}})
+            if ev.get("live_arrays") is not None:
+                trace.append({
+                    "name": "live_arrays", "ph": "C", "ts": ts,
+                    "pid": pid, "tid": 0,
+                    "args": {"count": ev["live_arrays"]}})
+            continue
+        args = {k: v for k, v in ev.items()
+                if k not in ("t", "event", "pid", "run_id",
+                             "trace_id", "span_id")}
+        trace.append({"name": kind, "cat": "event", "ph": "i", "s": "p",
+                      "ts": ts, "pid": pid, "tid": tid, "args": args})
+    meta = {"spans_matched": len(spans),
+            "spans_unmatched": len(unmatched),
+            "run_ids": sorted({e.get("run_id") for e in events
+                               if e.get("run_id")})}
+    return {"traceEvents": trace, "displayTimeUnit": "ms",
+            "otherData": meta}

@@ -1,0 +1,114 @@
+"""Hierarchical telemetry spans over the structured-log stream.
+
+A span is a timed region of host-side work — a driver run, one statics
+solve, one sweep shard, one retry attempt, one escalation rung.  Spans
+emit paired ``span_begin``/``span_end`` JSONL events carrying
+``trace_id`` (shared by a whole nested tree), ``span_id`` and the
+parent's id, propagated through a :mod:`contextvars` variable so
+nesting works across function boundaries (and stays correctly scoped
+per thread/async task).  Every other ``log_event`` fired inside a span
+automatically carries the enclosing trace/span ids, which is what lets
+``python -m raft_tpu.obs report`` attribute a ``shard_retry`` to the
+shard (and sweep) it happened in.
+
+Overhead discipline: with ``RAFT_TPU_LOG`` unset, a span is a sink
+check, a clock read and one histogram observe (a few microseconds) —
+no ids are generated, no contextvar is touched, nothing is emitted;
+the ``span_<name>_s`` wall-time histograms stay on either way, so a
+Prometheus scrape (``RAFT_TPU_METRICS``) carries per-stage timings
+even when the event stream is off.  All instrumentation is host-side
+only: spans never run under a jax trace, so the jaxpr contract suite
+sees zero new primitives.
+
+Device-trace alignment: when ``RAFT_TPU_PROFILE`` is set, each span
+also enters a ``jax.profiler.TraceAnnotation`` of the same name, so
+the host span shows up on the profiler timeline next to the XLA device
+slices it caused (the ``named_scope`` annotations inside the sweep's
+traced programs carry the same names down onto device ops).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+from raft_tpu.obs import metrics
+from raft_tpu.utils import config, structlog
+
+
+def _new_id():
+    return uuid.uuid4().hex[:16]
+
+
+def current_ids():
+    """(trace_id, span_id) of the innermost active span, or None."""
+    return structlog.SPAN_CTX.get()
+
+
+class span:
+    """Context manager for one telemetry span::
+
+        with obs.span("shard", shard=3, rows=256):
+            ...
+
+    Emits ``span_begin``/``span_end`` (the latter with ``wall_s``,
+    ``ok`` and a truncated ``error`` on failure) and observes the wall
+    time into the ``span_<name>_s`` histogram of the metrics registry.
+    Exceptions always propagate."""
+
+    __slots__ = ("name", "attrs", "trace_id", "span_id",
+                 "_token", "_t0", "_ann")
+
+    def __init__(self, name, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self.trace_id = None
+        self.span_id = None
+        self._token = None
+        self._t0 = None
+        self._ann = None
+
+    def __enter__(self):
+        if config.raw("PROFILE"):
+            # mirror the span onto the jax profiler timeline; must not
+            # be able to break the instrumented computation
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._ann = TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self._t0 = time.perf_counter()
+        if not structlog.enabled():
+            return self  # fast path: no ids, no contextvar, no event
+        parent = structlog.SPAN_CTX.get()
+        self.trace_id = parent[0] if parent else _new_id()
+        self.span_id = _new_id()
+        self._token = structlog.SPAN_CTX.set((self.trace_id, self.span_id))
+        structlog.log_event(
+            "span_begin", name=self.name,
+            parent_id=parent[1] if parent else None, **self.attrs)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        wall = time.perf_counter() - self._t0
+        # the wall-time histogram feeds unconditionally (metrics exist
+        # without the event stream); events only when the sink is live
+        metrics.histogram(f"span_{self.name}_s").observe(wall)
+        if self._token is not None:
+            kw = {}
+            if exc_type is not None:
+                kw["error"] = repr(exc)[:200]
+            structlog.log_event(
+                "span_end", name=self.name, wall_s=round(wall, 6),
+                ok=exc_type is None, **kw)
+            structlog.SPAN_CTX.reset(self._token)
+            self._token = None
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+            self._ann = None
+        return False

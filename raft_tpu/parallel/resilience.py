@@ -46,6 +46,17 @@ Every event flows through :mod:`raft_tpu.utils.structlog` (JSONL):
 ``shard_quarantine``, ``shard_escalate``, ``shard_escalate_failed``,
 ``backend_fallback``, ``manifest_mismatch``, ``sweep_done``.  Failure
 paths are exercised deterministically via :mod:`raft_tpu.utils.faults`.
+
+Telemetry (:mod:`raft_tpu.obs`, README "Observability"): the sweep,
+every shard, every retry attempt and every escalation rung run inside
+spans, so a captured JSONL stream reconstructs the full wall-time tree
+(``python -m raft_tpu.obs report``/``trace``); the metrics registry
+counts shards done/resumed, rows retried/quarantined/flagged and
+escalation outcomes, and its snapshot lands in the sweep manifest and
+``<out_dir>/metrics.json`` at ``sweep_done`` (Prometheus text to
+``RAFT_TPU_METRICS`` when set).  ``RAFT_TPU_HEARTBEAT_S`` samples
+device memory between shards; ``RAFT_TPU_PROFILE`` captures a jax
+profiler trace of the whole checkpointed sweep.
 """
 
 from __future__ import annotations
@@ -59,10 +70,14 @@ import time
 
 import numpy as np
 
+from raft_tpu.obs import metrics
+from raft_tpu.obs.heartbeat import maybe_heartbeat
+from raft_tpu.obs.spans import span
 from raft_tpu.utils import config, faults, health
 from raft_tpu.utils.structlog import log_event
 
 MANIFEST_NAME = "manifest.json"
+METRICS_NAME = "metrics.json"
 QUARANTINE_NAME = "quarantine.json"
 
 # fingerprint fields that determine the numerical content and layout of
@@ -422,8 +437,10 @@ def _escalate_row(compute, solo, status_before, mesh, shard, index):
     status_after = status_before
     for rung in escalation_rungs():
         tried.append(rung)
+        metrics.counter("escalation_rungs").inc()
         try:
-            with _rung_flags(rung):
+            with span("escalation_rung", shard=shard, index=index,
+                      rung=rung), _rung_flags(rung):
                 retried = {k: np.asarray(v)[:1]
                            for k, v in compute(solo,
                                                _rung_mesh(rung, mesh)).items()}
@@ -440,6 +457,7 @@ def _escalate_row(compute, solo, status_before, mesh, shard, index):
                   status_before=int(status_before), status_after=int(st),
                   resolved=healthy)
         if healthy:
+            metrics.counter("escalations_resolved").inc()
             return retried, tried, rung, st
     return None, tried, None, status_after
 
@@ -476,11 +494,17 @@ def eval_with_recovery(compute, chunk, shard, max_retries=3, backoff_s=0.5,
     attempt = 0
     while True:
         try:
-            faults.check("shard_eval")
-            return compute(chunk)
+            # span per attempt: a failing attempt is a span with
+            # ok=False + error, so retries are visible in the
+            # wall-time tree, not just as shard_retry events
+            with span("shard_attempt", shard=shard, rows=n,
+                      attempt=attempt + 1):
+                faults.check("shard_eval")
+                return compute(chunk)
         except Exception as e:
             if _is_oom(e) and n > 1:
                 half = n // 2
+                metrics.counter("shard_oom_splits").inc()
                 log_event("shard_oom_split", shard=shard, rows=n,
                           split=[half, n - half], error=str(e)[:200])
                 lo = eval_with_recovery(
@@ -493,6 +517,7 @@ def eval_with_recovery(compute, chunk, shard, max_retries=3, backoff_s=0.5,
             if _is_transient(e) and attempt < max_retries:
                 attempt += 1
                 delay = backoff_s * (2.0 ** (attempt - 1))
+                metrics.counter("shard_retries").inc()
                 log_event("shard_retry", shard=shard, attempt=attempt,
                           max_retries=max_retries, delay_s=round(delay, 3),
                           error=str(e)[:200])
@@ -559,6 +584,66 @@ def resolve_mesh(make_mesh, mesh=None):
 # ------------------------------------------------------------- sweep runner
 
 
+@contextlib.contextmanager
+def _maybe_profile():
+    """Capture a jax profiler trace of the block when ``RAFT_TPU_PROFILE``
+    is set (generalizes the bench-only capture to any checkpointed
+    sweep).  Profiling must never take the sweep down: start/stop
+    failures are logged (``profile_failed``) and swallowed."""
+    prof_dir = config.get("PROFILE")
+    if not prof_dir:
+        yield
+        return
+    started = False
+    try:
+        import jax
+
+        jax.profiler.start_trace(prof_dir)
+        started = True
+        log_event("profile_start", dir=prof_dir)
+    except Exception as e:
+        log_event("profile_failed", error=str(e)[:200])
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                log_event("profile_stop", dir=prof_dir)
+            except Exception as e:
+                log_event("profile_failed", error=str(e)[:200])
+
+
+def _dump_metrics(out_dir, manifest, counters0):
+    """Snapshot the metrics registry at sweep_done: ``metrics.json`` in
+    the checkpoint directory, a copy inside the manifest (so a resumed
+    run's manifest still carries the last completed picture), a
+    ``metrics_snapshot`` event, and the Prometheus text export when
+    ``RAFT_TPU_METRICS`` points somewhere.
+
+    The registry is process-cumulative; ``counters0`` is the counter
+    picture taken at sweep start, so ``counters`` in the dumped
+    snapshot is THIS sweep's delta (a second sweep in the same process
+    must not claim the first one's shards) — the raw totals stay
+    available under ``counters_total``."""
+    snap = metrics.snapshot()
+    snap["counters_total"] = dict(snap["counters"])
+    snap["counters"] = {k: v - counters0.get(k, 0)
+                        for k, v in snap["counters"].items()
+                        if v - counters0.get(k, 0)}
+    try:
+        _atomic_json(os.path.join(out_dir, METRICS_NAME), snap)
+        manifest["metrics"] = snap
+        _atomic_json(_manifest_path(out_dir), manifest)
+    except OSError:
+        pass  # telemetry must not fail the sweep that produced it
+    log_event("metrics_snapshot", snapshot=snap)
+    prom_path = config.get("METRICS")
+    if prom_path:
+        metrics.export(prom_path)
+    return snap
+
+
 def run_checkpointed(compute, cases, out_dir, shard_size, mesh, out_keys,
                      on_shard=None, max_retries=3, backoff_s=0.5,
                      quarantine_retry=True):
@@ -587,80 +672,119 @@ def run_checkpointed(compute, cases, out_dir, shard_size, mesh, out_keys,
     n_shards = (n + shard_size - 1) // shard_size
 
     fingerprint = compute_fingerprint(cases, out_keys, shard_size, mesh)
-    manifest = init_manifest(out_dir, fingerprint, n_shards)
-    log_event("sweep_start", out_dir=out_dir, n_cases=n, n_shards=n_shards,
-              shard_size=shard_size, out_keys=list(out_keys),
-              mesh_shape=fingerprint["mesh_shape"])
+    progress = {"out_dir": out_dir, "shards_done": 0, "n_shards": n_shards}
+    # profiler outermost: the sweep span's TraceAnnotation must begin
+    # INSIDE the active profiler session to land on the timeline
+    with _maybe_profile(), \
+            span("sweep", out_dir=out_dir, n_cases=n, n_shards=n_shards), \
+            maybe_heartbeat(devices=list(mesh.devices.flat),
+                            progress=progress) as heartbeat:
+        manifest = init_manifest(out_dir, fingerprint, n_shards)
+        log_event("sweep_start", out_dir=out_dir, n_cases=n,
+                  n_shards=n_shards, shard_size=shard_size,
+                  out_keys=list(out_keys),
+                  mesh_shape=fingerprint["mesh_shape"])
 
-    t0 = time.perf_counter()
-    results = []
-    n_quarantined = 0
-    n_flagged = 0
-    for s in range(n_shards):
-        path = os.path.join(out_dir, f"shard_{s:04d}.npz")
-        sl = slice(s * shard_size, min((s + 1) * shard_size, n))
-        rows = sl.stop - sl.start
-        if os.path.exists(path):
-            try:
-                out = load_shard(path, out_keys, expect_rows=rows)
-                results.append(out)
-                n_flagged += len(flagged_rows(out))
-                log_event("shard_resume", shard=s, rows=rows)
-                if on_shard is not None:
-                    on_shard(s + 1, n_shards, False)
-                continue
-            except ShardCorruptError as e:
-                # re-queue: a truncated/stale shard is recomputed, not fatal
-                log_event("shard_corrupt", shard=s, error=str(e)[:300])
+        t0 = time.perf_counter()
+        counters0 = dict(metrics.snapshot()["counters"])
+        results = []
+        n_quarantined = 0
+        n_flagged = 0
+        for s in range(n_shards):
+            path = os.path.join(out_dir, f"shard_{s:04d}.npz")
+            sl = slice(s * shard_size, min((s + 1) * shard_size, n))
+            rows = sl.stop - sl.start
+            if os.path.exists(path):
                 try:
-                    os.unlink(path)
-                except OSError:
-                    pass
-        log_event("shard_start", shard=s, rows=rows)
-        mark_shard(manifest, out_dir, s, "running")
-        t_sh = time.perf_counter()
-        chunk = {k: v[sl] for k, v in cases.items()}
-        out = eval_with_recovery(
-            lambda c: {k: np.asarray(v)[: len(next(iter(c.values())))]
-                       for k, v in compute(c, mesh).items()},
-            chunk, s, max_retries=max_retries, backoff_s=backoff_s)
-        if faults.take("nan", "shard_result"):
-            for k, v in out.items():
-                a = np.array(v)
-                if np.issubdtype(a.dtype, np.inexact):
-                    a[0] = np.nan
-                    out[k] = a
-        bad = nonfinite_rows(out)
-        flagged = flagged_rows(out)
-        entries = []
-        if bad.size or flagged.size:
-            out, entries = _quarantine_shard(
-                compute, chunk, out, bad, flagged, s, sl.start, mesh,
-                retry_solo=quarantine_retry)
-        # re-judge even when clean: a recomputed shard must clear its own
-        # stale quarantine entries from a previous run (no file is
-        # created for sweeps that never quarantined anything)
-        if entries or os.path.exists(_quarantine_path(out_dir)):
-            record_quarantine(out_dir, s, entries)
-        # rows still bad after recovery/escalation (resolved escalation
-        # entries are audit records, not quarantined rows)
-        shard_quarantined = sum(1 for e in entries if not e.get("resolved"))
-        n_quarantined += shard_quarantined
-        shard_flagged = len(flagged_rows(out))  # severe bits persisting
-        n_flagged += shard_flagged
-        atomic_savez(path, **out)
-        mark_shard(manifest, out_dir, s, "done",
-                   wall_s=round(time.perf_counter() - t_sh, 3),
-                   quarantined=shard_quarantined, flagged=shard_flagged)
-        log_event("shard_done", shard=s, rows=rows,
-                  wall_s=round(time.perf_counter() - t_sh, 3))
-        results.append(out)
-        if on_shard is not None:
-            on_shard(s + 1, n_shards, True)
+                    out = load_shard(path, out_keys, expect_rows=rows)
+                    results.append(out)
+                    resumed_flagged = len(flagged_rows(out))
+                    # rows still bad in the stored shard (NaN or severe
+                    # bits) ARE this sweep's quarantined rows even when
+                    # the shard resumed from disk — otherwise a resumed
+                    # run reports n_quarantined=0 while the shard data
+                    # and quarantine.json still carry the poison
+                    resumed_bad = len({int(i) for i in nonfinite_rows(out)}
+                                      | {int(i) for i in flagged_rows(out)})
+                    n_flagged += resumed_flagged
+                    n_quarantined += resumed_bad
+                    metrics.counter("shards_resumed").inc()
+                    metrics.counter("rows_flagged").inc(resumed_flagged)
+                    metrics.counter("rows_quarantined").inc(resumed_bad)
+                    log_event("shard_resume", shard=s, rows=rows)
+                    progress["shards_done"] = s + 1
+                    if on_shard is not None:
+                        on_shard(s + 1, n_shards, False)
+                    continue
+                except ShardCorruptError as e:
+                    # re-queue: a truncated/stale shard is recomputed,
+                    # not fatal
+                    metrics.counter("shards_corrupt").inc()
+                    log_event("shard_corrupt", shard=s, error=str(e)[:300])
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+            with span("shard", shard=s, rows=rows):
+                log_event("shard_start", shard=s, rows=rows)
+                mark_shard(manifest, out_dir, s, "running")
+                t_sh = time.perf_counter()
+                chunk = {k: v[sl] for k, v in cases.items()}
+                out = eval_with_recovery(
+                    lambda c: {k: np.asarray(v)[: len(next(iter(c.values())))]
+                               for k, v in compute(c, mesh).items()},
+                    chunk, s, max_retries=max_retries, backoff_s=backoff_s)
+                if faults.take("nan", "shard_result"):
+                    for k, v in out.items():
+                        a = np.array(v)
+                        if np.issubdtype(a.dtype, np.inexact):
+                            a[0] = np.nan
+                            out[k] = a
+                bad = nonfinite_rows(out)
+                flagged = flagged_rows(out)
+                entries = []
+                if bad.size or flagged.size:
+                    out, entries = _quarantine_shard(
+                        compute, chunk, out, bad, flagged, s, sl.start, mesh,
+                        retry_solo=quarantine_retry)
+                # re-judge even when clean: a recomputed shard must clear
+                # its own stale quarantine entries from a previous run (no
+                # file is created for sweeps that never quarantined
+                # anything)
+                if entries or os.path.exists(_quarantine_path(out_dir)):
+                    record_quarantine(out_dir, s, entries)
+                # rows still bad after recovery/escalation (resolved
+                # escalation entries are audit records, not quarantined
+                # rows)
+                shard_quarantined = sum(
+                    1 for e in entries if not e.get("resolved"))
+                n_quarantined += shard_quarantined
+                shard_flagged = len(flagged_rows(out))  # severe bits left
+                n_flagged += shard_flagged
+                atomic_savez(path, **out)
+                mark_shard(manifest, out_dir, s, "done",
+                           wall_s=round(time.perf_counter() - t_sh, 3),
+                           quarantined=shard_quarantined,
+                           flagged=shard_flagged)
+                metrics.counter("shards_done").inc()
+                metrics.counter("rows_evaluated").inc(rows)
+                metrics.counter("rows_quarantined").inc(shard_quarantined)
+                metrics.counter("rows_flagged").inc(shard_flagged)
+                log_event("shard_done", shard=s, rows=rows,
+                          wall_s=round(time.perf_counter() - t_sh, 3))
+            results.append(out)
+            progress["shards_done"] = s + 1
+            if on_shard is not None:
+                on_shard(s + 1, n_shards, True)
 
-    log_event("sweep_done", out_dir=out_dir, n_cases=n,
-              n_quarantined=n_quarantined, n_flagged=n_flagged,
-              wall_s=round(time.perf_counter() - t0, 3))
+        if heartbeat is not None:
+            # terminal beat BEFORE the snapshot: the end-of-run memory
+            # watermark must be inside metrics.json, not after it
+            heartbeat.stop()
+        _dump_metrics(out_dir, manifest, counters0)
+        log_event("sweep_done", out_dir=out_dir, n_cases=n,
+                  n_quarantined=n_quarantined, n_flagged=n_flagged,
+                  wall_s=round(time.perf_counter() - t0, 3))
     return {k: np.concatenate([r[k] for r in results]) for k in out_keys}
 
 

@@ -25,7 +25,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from raft_tpu.obs import metrics
+from raft_tpu.obs.spans import span
 from raft_tpu.utils.dtypes import compute_dtypes
+from raft_tpu.utils.structlog import log_event
+
 
 def _mesh_key(mesh):
     return (tuple(mesh.axis_names), mesh.devices.shape,
@@ -75,6 +79,13 @@ def _cached_jit(evaluate, key, build):
     except AttributeError:  # no attribute dict: no memoization
         return build()
     if key not in per:
+        # first build for this memo key: the next dispatch traces and
+        # compiles — worth a telemetry mark, because an unexpected
+        # growth of this counter IS the recompile storm the sentinel
+        # (raft_tpu.analysis.recompile) exists to catch
+        metrics.counter("sweep_programs_built").inc()
+        log_event("sweep_program_built", kind=key[0],
+                  out_keys=list(key[1]))
         per[key] = build()
     return per[key]
 
@@ -117,15 +128,21 @@ def sweep_cases(evaluate, Hs, Tp, beta, mesh=None, out_keys=("PSD", "X0")):
     sharding = NamedSharding(mesh, P("dp"))
 
     def build():
-        batched = jax.vmap(
-            lambda h, t, b: {k: evaluate(h, t, b)[k] for k in out_keys})
-        return jax.jit(batched,
+        def one(h, t, b):
+            # named_scope: device ops from the sweep body carry this
+            # name on profiler timelines (metadata only — adds no
+            # primitives, jaxpr contracts unchanged)
+            with jax.named_scope("sweep_cases"):
+                return {k: evaluate(h, t, b)[k] for k in out_keys}
+
+        return jax.jit(jax.vmap(one),
                        in_shardings=(sharding, sharding, sharding))
 
     fn = _cached_jit(evaluate, ("cases", tuple(out_keys), _mesh_key(mesh),
                                 _flags_key()), build)
     args = [jax.device_put(jnp.asarray(x), sharding) for x in (Hs, Tp, beta)]
-    return fn(*args)
+    with span("sweep_dispatch", kind="cases", rows=len(args[0])):
+        return fn(*args)
 
 
 def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
@@ -175,16 +192,22 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
         return NamedSharding(mesh, P("dp"))
 
     def build():
-        batched = jax.vmap(lambda c: {k: evaluate(c)[k] for k in out_keys})
+        def one(c):
+            with jax.named_scope("sweep_cases_full"):
+                return {k: evaluate(c)[k] for k in out_keys}
+
         out_sh = {k: out_spec(k) for k in out_keys}
-        return jax.jit(batched, in_shardings=(in_sh,), out_shardings=out_sh)
+        return jax.jit(jax.vmap(one), in_shardings=(in_sh,),
+                       out_shardings=out_sh)
 
     fn = _cached_jit(
         evaluate, ("cases_full", tuple(out_keys), tuple(sorted(cases)),
                    bool(shard_freq), _mesh_key(mesh), _flags_key()), build)
     args = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(jnp.asarray(x), s), dict(cases), in_sh)
-    return fn(args)
+    with span("sweep_dispatch", kind="cases_full",
+              rows=next(iter(lengths.values()))):
+        return fn(args)
 
 
 def run_sweep_checkpointed_full(evaluate, cases, out_dir, shard_size=256,

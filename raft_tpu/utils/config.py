@@ -188,11 +188,26 @@ _register(
               "enables x64 for the parity path)"),
     Flag("LOG", "raw", "",
          help="structured-log sink: '-' for stderr, else a JSONL path"),
+    # -- telemetry (see raft_tpu.obs and README "Observability")
+    Flag("RUN_ID", "raw", "",
+         help="telemetry run id stamped on every structured-log record "
+              "(default: a fresh uuid per process; pin it so a resumed "
+              "sweep's events stay linkable to the original run)"),
+    Flag("HEARTBEAT_S", "float", 0.0,
+         help="device-heartbeat sampling period in seconds (0 disables): "
+              "a daemon thread emits per-device memory_stats, live-buffer "
+              "counts and shard progress as 'heartbeat' events + gauges"),
+    Flag("METRICS", "str", "",
+         help="when set, the metrics registry is exported in Prometheus "
+              "text format to this path at sweep_done (scrape target "
+              "for long runs)"),
     Flag("FAULTS", "raw", "",
          help="deterministic fault injection: comma list of "
               "kind:site[:count] specs (see raft_tpu.utils.faults)"),
     Flag("PROFILE", "str", "",
-         help="when set, bench captures a jax profiler trace here"),
+         help="when set, the bench AND any checkpointed sweep capture a "
+              "jax profiler trace into this directory; telemetry spans "
+              "mirror onto the profiler timeline as TraceAnnotations"),
     # -- bench harness
     Flag("PEAK_TFLOPS", "float", 90.0,
          help="assumed peak TF/s for the bench MFU estimate"),

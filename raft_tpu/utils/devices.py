@@ -62,6 +62,12 @@ def enable_compile_cache(cache_dir=None, platform=None,
     """
     import jax
 
+    # every entry point that wants compile caching also wants compile
+    # *counting*: arm the telemetry feed (xla_compiles counter) here so
+    # drivers/sweeps/bench all get it without a separate call
+    from raft_tpu.analysis.recompile import install as _install_sentinel
+
+    _install_sentinel()
     if platform:
         jax.config.update("jax_platforms", platform)
     if cache_dir is None:

@@ -10,22 +10,68 @@ Off by default (zero overhead beyond an env check).  Enable with
     RAFT_TPU_LOG=-            # JSONL to stderr
     RAFT_TPU_LOG=/path/f.jsonl  # JSONL appended to a file
 
-Events carry a monotonic ``t`` (seconds since process start) and a
-``event`` name; everything else is free-form numeric/str payload.
+Every record carries a monotonic ``t`` (seconds since process start),
+an ``event`` name, the emitting ``pid`` and the process ``run_id``
+(``RAFT_TPU_RUN_ID``, else a fresh uuid per process — pin it to keep a
+resumed sweep's events linkable to the original run); records emitted
+inside an :func:`raft_tpu.obs.span` additionally carry ``trace_id``/
+``span_id``, so free-form events nest under the span that produced
+them.  Everything else is free-form numeric/str payload.
+
+The sink is shared by the main thread and the telemetry threads
+(heartbeat sampler, :mod:`raft_tpu.obs.heartbeat`), so writes are
+serialized by a lock — interleaved half-lines would corrupt the JSONL
+stream for every downstream consumer (``python -m raft_tpu.obs
+report``/``trace``).
+
+Event *names* are registered centrally in :mod:`raft_tpu.obs.events`
+and lint-enforced (``event-name`` rule): a typo'd name silently splits
+an event stream, which is worse than a crash.
 """
 
 from __future__ import annotations
 
 import atexit
+import contextvars
 import json
+import os
 import sys
+import threading
 import time
+import uuid
 
 from raft_tpu.utils import config
 
 _T0 = time.perf_counter()
 _SINK = None
 _DEST = None
+# RLock: log_event re-resolves the sink while holding the lock (the
+# handle must not be swapped/closed between resolution and write by a
+# concurrent retarget), and _sink() itself locks the swap
+_LOCK = threading.RLock()
+_RUN_ID = None
+
+#: (trace_id, span_id) of the innermost active telemetry span in this
+#: task/thread; managed by :class:`raft_tpu.obs.spans.span`.
+SPAN_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "raft_tpu_span_ctx", default=None)
+
+
+def run_id():
+    """The telemetry run id stamped on every record: ``RAFT_TPU_RUN_ID``
+    when set (re-read per call so a resuming orchestrator can pin it),
+    else one fresh uuid12 per process."""
+    rid = config.raw("RUN_ID")
+    if rid:
+        return rid
+    global _RUN_ID
+    if _RUN_ID is None:
+        # locked: the heartbeat thread's first beat can race the main
+        # thread's first event — one process must get ONE run id
+        with _LOCK:
+            if _RUN_ID is None:
+                _RUN_ID = uuid.uuid4().hex[:12]
+    return _RUN_ID
 
 
 def _sink():
@@ -36,19 +82,21 @@ def _sink():
     global _SINK, _DEST
     dest = config.raw("LOG") or ""
     if dest != _DEST:
-        if _SINK is not None and _SINK is not sys.stderr:
-            try:
-                _SINK.close()
-            except Exception:
-                pass
-        _DEST = dest
-        if dest == "-":
-            _SINK = sys.stderr
-        elif dest:
-            _SINK = open(dest, "a")
-            atexit.register(_SINK.close)
-        else:
-            _SINK = None
+        with _LOCK:
+            if dest != _DEST:
+                if _SINK is not None and _SINK is not sys.stderr:
+                    try:
+                        _SINK.close()
+                    except Exception:
+                        pass
+                if dest == "-":
+                    _SINK = sys.stderr
+                elif dest:
+                    _SINK = open(dest, "a")
+                    atexit.register(_SINK.close)
+                else:
+                    _SINK = None
+                _DEST = dest
     return _SINK
 
 
@@ -61,7 +109,11 @@ def log_event(event, **payload):
     s = _sink()
     if s is None:
         return
-    rec = {"t": round(time.perf_counter() - _T0, 6), "event": event}
+    rec = {"t": round(time.perf_counter() - _T0, 6), "event": event,
+           "pid": os.getpid(), "run_id": run_id()}
+    ctx = SPAN_CTX.get()
+    if ctx is not None:
+        rec["trace_id"], rec["span_id"] = ctx
     for k, v in payload.items():
         if hasattr(v, "item"):
             try:
@@ -72,15 +124,29 @@ def log_event(event, **payload):
     # default=str: a non-JSON-serializable payload value (Path, dtype,
     # exception, device object) must never take down the analysis that
     # was merely trying to log it
-    s.write(json.dumps(rec, default=str) + "\n")
-    s.flush()
+    line = json.dumps(rec, default=str) + "\n"
+    # one lock around resolve+write+flush: the heartbeat thread shares
+    # the sink, and a concurrent RAFT_TPU_LOG retarget closes the old
+    # handle — re-resolving under the lock keeps the write off a handle
+    # another thread just closed
+    with _LOCK:
+        s = _sink()
+        if s is None:
+            return
+        s.write(line)
+        s.flush()
 
 
 class stage:
     """Context manager timing one analysis stage:
 
     with stage("solve_dynamics", case=2): ...
-    emits {"event": "solve_dynamics", "wall_s": ..., **kw} on exit."""
+    emits {"event": "solve_dynamics", "wall_s": ..., **kw} on exit;
+    a failing stage carries ok=False plus a truncated error=repr(exc).
+
+    Prefer :func:`raft_tpu.obs.span` for new instrumentation — spans
+    add trace/parent linkage and feed the metrics registry; ``stage``
+    stays for flat one-shot timings and backward compatibility."""
 
     def __init__(self, name, **kw):
         self.name = name
@@ -92,6 +158,9 @@ class stage:
 
     def __exit__(self, *exc):
         if enabled():
+            kw = dict(self.kw)
+            if exc[0] is not None:
+                kw["error"] = repr(exc[1])[:200]
             log_event(self.name, wall_s=round(time.perf_counter() - self.t0, 6),
-                      ok=exc[0] is None, **self.kw)
+                      ok=exc[0] is None, **kw)
         return False

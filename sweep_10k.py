@@ -138,13 +138,21 @@ def main():
     # loads shards from disk in seconds and must not overwrite the
     # artifact with a bogus thousands-of-evals/s headline
     fresh_designs = min(n_fresh[0] * args.shard, n_done)
-    # quarantined designs (rows still bad after recovery/escalation) are
+    # reliability headline numbers come from the telemetry metrics
+    # snapshot — the SAME counters the runtime increments and dumps to
+    # <out_dir>/metrics.json at sweep_done — so this artifact and the
+    # runtime's own accounting cannot drift (the previous ad-hoc
+    # re-derivation from quarantine.json counted across ALL prior runs
+    # while sweep_done counted this run only).  Quarantined designs are
     # excluded from the aggregates via nan-aware reductions — one
     # non-converged drag linearization must not poison the ranges.
-    # Resolved escalation entries are audit records, not quarantined
-    # rows (same rule as the runtime's sweep_done n_quarantined).
-    quarantined = [e for e in resilience.load_quarantine(args.out)
-                   if not e.get("resolved")]
+    from raft_tpu.obs import metrics
+
+    cnt = metrics.snapshot()["counters"]
+    # quarantine.json keeps the cross-run audit list (resolved
+    # escalation entries are audit records, not quarantined rows)
+    quarantine_listed = [e for e in resilience.load_quarantine(args.out)
+                         if not e.get("resolved")]
     # per-bit solver-health counts over the whole DoE (the in-band
     # status words persisted in the shards; see README "Solver health")
     from raft_tpu.utils import health
@@ -155,10 +163,15 @@ def main():
                  if ((status & mask) != 0).any()}
     summary = dict(
         n_designs=int(n_done),
-        n_quarantined=len(quarantined),
+        n_quarantined=cnt.get("rows_quarantined", 0),
+        n_quarantined_listed=len(quarantine_listed),
         n_flagged=n_flagged,
-        n_flagged_severe=int(
-            ((status & np.int32(health.SEVERE)) != 0).sum()),
+        n_flagged_severe=cnt.get("rows_flagged", 0),
+        shard_retries=cnt.get("shard_retries", 0),
+        shard_oom_splits=cnt.get("shard_oom_splits", 0),
+        escalation_rungs=cnt.get("escalation_rungs", 0),
+        escalations_resolved=cnt.get("escalations_resolved", 0),
+        xla_compiles=cnt.get("xla_compiles", 0),
         cases_per_design=len(bench.CASES),
         n_freq=int(model.nw),
         wall_s=round(wall, 2),

@@ -58,6 +58,26 @@ def test_bad_jit_fixture():
     assert any("mode" in f.message for f in found)
 
 
+def test_bad_event_fixture():
+    found = run_fixture("bad_event.py")
+    assert {f.rule for f in found} == {"event-name"}
+    # registered names (8, 12) and the dynamic name (11) are clean
+    assert {f.line for f in found} == {9, 10}
+    assert any("shard_don" in f.message for f in found)
+
+
+def test_event_registry_covers_runtime_emitters():
+    """Every literal log_event name in the scanned tree is registered
+    (the CI-gate property the rule exists for), and the registry itself
+    describes fields for each name."""
+    from raft_tpu.obs import events
+
+    findings = [f for f in lint.lint_paths() if f.rule == "event-name"]
+    assert findings == [], "\n".join(f.format() for f in findings)
+    for name, fields, help_ in events.describe():
+        assert fields and help_, name
+
+
 def test_suppressions_silence_findings():
     assert run_fixture("suppressed.py") == []
 

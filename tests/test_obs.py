@@ -1,0 +1,397 @@
+"""Telemetry-subsystem tests (:mod:`raft_tpu.obs`).
+
+Fast tier, toy evaluators on a small CPU mesh (no model build):
+
+* span nesting / parent-id propagation, including across the
+  checkpointed-sweep path with a resume (pinned ``RAFT_TPU_RUN_ID``
+  keeps both runs' events linkable);
+* the zero-overhead fast path with ``RAFT_TPU_LOG`` unset;
+* metrics-registry thread safety and histogram percentile estimates;
+* the metrics snapshot landing in ``metrics.json`` + the sweep
+  manifest, and the Prometheus text export;
+* Chrome-trace export round-trip (valid JSON, balanced spans) and the
+  report CLI on a capture with injected faults;
+* the device heartbeat sampler.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.obs import current_ids, metrics, span
+from raft_tpu.obs import report as obs_report
+from raft_tpu.obs.heartbeat import Heartbeat
+from raft_tpu.parallel.sweep import make_mesh, run_sweep_checkpointed_full
+from raft_tpu.utils import faults, structlog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def toy_full(c):
+    return {"PSD": jnp.stack([c["Hs"], c["Tp"], c["Hs"] * c["Tp"]]),
+            "X0": c["Hs"] - c["Tp"]}
+
+
+def _cases(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return dict(Hs=2.0 + 6.0 * rng.random(n), Tp=8.0 + 8.0 * rng.random(n))
+
+
+def _events(path, name=None):
+    evs, bad = obs_report.read_events(path)
+    assert bad == 0
+    return [e for e in evs if name is None or e["event"] == name]
+
+
+MESH = None
+
+
+def mesh2():
+    global MESH
+    if MESH is None:
+        MESH = make_mesh(2)
+    return MESH
+
+
+@pytest.fixture
+def log_path(tmp_path, monkeypatch):
+    p = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", p)
+    return p
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# ------------------------------------------------------------------ spans
+
+
+def test_span_nesting_and_parent_ids(log_path):
+    with span("outer", job=1) as outer:
+        with span("inner") as inner:
+            structlog.log_event("drag_linearisation", case=0, fowt=0,
+                                resid=1e-3, converged=True, n_iter=3,
+                                status=0, reason="")
+        with span("inner") as inner2:
+            pass
+    begins = {e["span_id"]: e for e in _events(log_path, "span_begin")}
+    assert len(begins) == 3
+    bo = begins[outer.span_id]
+    bi, bi2 = begins[inner.span_id], begins[inner2.span_id]
+    assert bo["parent_id"] is None and bo["name"] == "outer" and bo["job"] == 1
+    assert bi["parent_id"] == outer.span_id
+    assert bi2["parent_id"] == outer.span_id
+    # one trace id for the whole tree, stamped on every record inside
+    assert bo["trace_id"] == bi["trace_id"] == bi2["trace_id"]
+    (free_ev,) = _events(log_path, "drag_linearisation")
+    assert free_ev["span_id"] == inner.span_id
+    assert free_ev["trace_id"] == outer.trace_id
+    ends = _events(log_path, "span_end")
+    assert len(ends) == 3 and all(e["ok"] and "wall_s" in e for e in ends)
+    # pid + run_id are stamped on every record
+    for e in _events(log_path):
+        assert e["pid"] == os.getpid() and e["run_id"]
+    # the context is fully unwound
+    assert current_ids() is None
+
+
+def test_span_failure_records_error_and_reraises(log_path):
+    with pytest.raises(ValueError, match="boom"):
+        with span("failing"):
+            raise ValueError("boom")
+    (end,) = _events(log_path, "span_end")
+    assert end["ok"] is False and "ValueError" in end["error"]
+    assert current_ids() is None
+
+
+def test_zero_overhead_fast_path_when_log_unset(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_LOG", raising=False)
+    monkeypatch.delenv("RAFT_TPU_PROFILE", raising=False)
+    with span("quiet", x=1) as s:
+        # no ids generated, no contextvar touched, nothing emitted
+        assert s.span_id is None and current_ids() is None
+    assert not structlog.enabled()
+    # the wall-time histogram still feeds (metrics are independent of
+    # the event stream) — but no event was produced anywhere
+    assert metrics.histogram("span_quiet_s").count == 1
+
+
+def test_sweep_spans_and_run_id_survive_resume(tmp_path, monkeypatch):
+    p = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", p)
+    monkeypatch.setenv("RAFT_TPU_RUN_ID", "linkage01")
+    cases = _cases(8, seed=1)
+    out_dir = str(tmp_path / "sweep")
+    out1 = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                       shard_size=4, mesh=mesh2())
+    faults.truncate_file(os.path.join(out_dir, "shard_0001.npz"))
+    out2 = run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                       shard_size=4, mesh=mesh2())
+    for k in out1:
+        assert np.array_equal(out1[k], out2[k])
+    evs = _events(p)
+    # both runs share the pinned run id on EVERY record
+    assert {e["run_id"] for e in evs} == {"linkage01"}
+    spans, unmatched = obs_report.collect_spans(evs)
+    assert unmatched == []
+    paths, _ = obs_report.span_paths(spans)
+    # two sweep roots (run + resume), shards + attempts nested beneath
+    assert len(paths[("sweep",)]) == 2
+    assert len(paths[("sweep", "shard")]) == 3  # 2 fresh + 1 recomputed
+    assert ("sweep", "shard", "shard_attempt") in paths
+    # shard events carry the enclosing shard span's ids
+    by_id = {s["span_id"]: s for s in spans}
+    for e in _events(p, "shard_done"):
+        assert by_id[e["span_id"]]["name"] == "shard"
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_metrics_registry_thread_safety():
+    c = metrics.counter("t_conc")
+    h = metrics.histogram("t_conc_h")
+
+    def work():
+        for i in range(2000):
+            c.inc()
+            h.observe(i % 7 + 0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8 * 2000
+    assert h.count == 8 * 2000
+    assert h.min == 0.5 and h.max == 6.5
+
+
+def test_histogram_percentiles_and_snapshot():
+    h = metrics.histogram("t_hist")
+    for v in [0.01] * 50 + [0.1] * 45 + [10.0] * 5:
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert snap["min"] == 0.01 and snap["max"] == 10.0
+    # log-bucket estimates: p50 lands in the 0.01 bucket, p95 well
+    # below the 10.0 outliers' bucket ceiling
+    assert snap["p50"] <= 0.02
+    assert 0.05 <= snap["p95"] <= 0.2
+    assert metrics.histogram("t_empty").snapshot() == {"count": 0}
+    assert metrics.histogram("t_empty").percentile(0.5) is None
+
+
+def test_kind_collision_is_loud():
+    metrics.counter("t_kind")
+    with pytest.raises(TypeError, match="already registered"):
+        metrics.gauge("t_kind")
+
+
+def test_prometheus_export(tmp_path):
+    metrics.counter("t_prom").inc(4)
+    metrics.gauge("t_gauge").set(7.0)
+    metrics.gauge("t_gauge").set(3.0)
+    metrics.histogram("t_ph").observe(0.5)
+    text = metrics.to_prometheus()
+    assert "# TYPE raft_tpu_t_prom counter\nraft_tpu_t_prom 4" in text
+    assert "raft_tpu_t_gauge 3.0" in text and "raft_tpu_t_gauge_max 7.0" in text
+    assert 'raft_tpu_t_ph_bucket{le="+Inf"} 1' in text
+    assert "raft_tpu_t_ph_count 1" in text
+    path = tmp_path / "m.prom"
+    assert metrics.export(str(path))
+    assert path.read_text() == text
+
+
+def test_sweep_dumps_metrics_snapshot(tmp_path, log_path, monkeypatch):
+    prom = str(tmp_path / "scrape.prom")
+    monkeypatch.setenv("RAFT_TPU_METRICS", prom)
+    cases = _cases(8, seed=2)
+    out_dir = str(tmp_path / "sweep")
+    with faults.inject("transient:shard_eval:1"):
+        run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                    shard_size=4, mesh=mesh2(),
+                                    backoff_s=0.01)
+    with open(os.path.join(out_dir, "metrics.json")) as f:
+        snap = json.load(f)
+    assert snap["counters"]["shards_done"] == 2
+    assert snap["counters"]["shard_retries"] == 1
+    assert snap["counters"]["rows_evaluated"] == 8
+    # the same snapshot is embedded in the manifest...
+    with open(os.path.join(out_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["metrics"]["counters"] == snap["counters"]
+    # ...emitted as an event...
+    (ev,) = _events(log_path, "metrics_snapshot")
+    assert ev["snapshot"]["counters"]["shards_done"] == 2
+    # ...and exported as Prometheus text
+    with open(prom) as f:
+        text = f.read()
+    assert "raft_tpu_shards_done 2" in text
+    assert "raft_tpu_shard_retries 1" in text
+
+
+def test_resumed_quarantined_rows_counted(tmp_path, log_path):
+    """A resumed run must not report rows_quarantined=0 while the
+    resumed shards still carry NaN-poisoned rows."""
+    def toy_nan(c):
+        bad = c["Hs"] < 0
+        return {"PSD": jnp.where(bad, jnp.nan,
+                                 jnp.stack([c["Hs"], c["Tp"], c["Hs"]])),
+                "X0": jnp.where(bad, jnp.nan, c["Hs"] - c["Tp"])}
+
+    cases = _cases(8, seed=6)
+    cases["Hs"][5] = -1.0
+    out_dir = str(tmp_path / "sweep")
+    run_sweep_checkpointed_full(toy_nan, cases, out_dir, shard_size=4,
+                                mesh=mesh2(), quarantine_retry=False)
+    assert metrics.counter("rows_quarantined").value == 1
+    metrics.reset()
+    # full resume: every shard loads from disk, the poison persists
+    run_sweep_checkpointed_full(toy_nan, cases, out_dir, shard_size=4,
+                                mesh=mesh2(), quarantine_retry=False)
+    assert metrics.counter("rows_quarantined").value == 1
+    done = _events(log_path, "sweep_done")
+    assert [e["n_quarantined"] for e in done] == [1, 1]
+
+
+# ------------------------------------------------------------- CLI tooling
+
+
+def _run_faulty_sweep(tmp_path, log_path):
+    """One checkpointed sweep with a retried transient fault AND a
+    quarantined NaN row — the acceptance capture."""
+    cases = _cases(8, seed=3)
+    out_dir = str(tmp_path / "sweep")
+    with faults.inject("transient:shard_eval:1", "nan:shard_result:1"):
+        run_sweep_checkpointed_full(toy_full, cases, out_dir,
+                                    shard_size=4, mesh=mesh2(),
+                                    backoff_s=0.01, quarantine_retry=False)
+    assert len(_events(log_path, "shard_retry")) == 1
+    assert len(_events(log_path, "shard_quarantine")) == 1
+    return out_dir
+
+
+def test_chrome_trace_roundtrip(tmp_path, log_path):
+    _run_faulty_sweep(tmp_path, log_path)
+    out = str(tmp_path / "trace.json")
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs", "trace", log_path, "-o", out],
+        capture_output=True, text=True, cwd=REPO)
+    assert p.returncode == 0, p.stdout + p.stderr
+    with open(out) as f:
+        trace = json.load(f)  # valid JSON round-trip
+    evs = trace["traceEvents"]
+    slices = [e for e in evs if e["ph"] == "X"]
+    # every span begin matched an end (balanced), none dropped
+    assert trace["otherData"]["spans_unmatched"] == 0
+    assert len(slices) == trace["otherData"]["spans_matched"] > 0
+    assert {s["name"] for s in slices} >= {"sweep", "shard", "shard_attempt"}
+    for e in evs:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+    for s in slices:
+        assert s["dur"] >= 0
+    # the failed attempt slice carries the error
+    fails = [s for s in slices if s["args"].get("error")]
+    assert len(fails) == 1 and "Transient" in fails[0]["args"]["error"]
+    # instant events for the non-span stream
+    assert any(e["ph"] == "i" and e["name"] == "shard_retry" for e in evs)
+
+
+def test_report_cli_smoke(tmp_path, log_path):
+    _run_faulty_sweep(tmp_path, log_path)
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs", "report", log_path],
+        capture_output=True, text=True, cwd=REPO)
+    assert p.returncode == 0, p.stdout + p.stderr
+    out = p.stdout
+    assert "span wall-time tree" in out
+    assert "sweep" in out and "shard_attempt" in out
+    assert "counters (final metrics snapshot)" in out
+    assert "shard_retries" in out
+    assert "reliability summary" in out
+    assert "retries: 1" in out
+    assert "quarantine judgements: 1" in out
+    # empty/garbage input exits 2, not a traceback
+    bad = tmp_path / "empty.jsonl"
+    bad.write_text("not json\n")
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs", "report", str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert p.returncode == 2
+
+
+def test_events_cli_lists_registry():
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs", "events"],
+        capture_output=True, text=True, cwd=REPO)
+    assert p.returncode == 0
+    assert "span_begin" in p.stdout and "heartbeat" in p.stdout
+
+
+# -------------------------------------------------------------- heartbeat
+
+
+def test_heartbeat_samples_devices_and_progress(log_path):
+    progress = {"shards_done": 0, "n_shards": 2}
+    hb = Heartbeat(0.02, progress=progress)
+    hb.beat()  # deterministic single sample (no thread timing)
+    progress["shards_done"] = 1
+    hb.beat()
+    evs = _events(log_path, "heartbeat")
+    assert len(evs) == 2
+    assert evs[0]["devices"] and "kind" in evs[0]["devices"][0]
+    assert evs[0]["live_arrays"] is not None
+    assert [e["progress"]["shards_done"] for e in evs] == [0, 1]
+    assert metrics.gauge("live_arrays").value is not None
+
+
+def test_heartbeat_thread_lifecycle(log_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_HEARTBEAT_S", "0.02")
+    from raft_tpu.obs.heartbeat import maybe_heartbeat
+
+    with maybe_heartbeat(progress={"stage": "x"}) as hb:
+        assert hb is not None and hb.is_alive()
+        time.sleep(0.1)
+    assert not hb.is_alive()
+    # sampled while running, plus the final beat on stop
+    assert len(_events(log_path, "heartbeat")) >= 2
+
+
+def test_heartbeat_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_HEARTBEAT_S", raising=False)
+    from raft_tpu.obs.heartbeat import maybe_heartbeat
+
+    with maybe_heartbeat() as hb:
+        assert hb is None
+
+
+# -------------------------------------------------------------- structlog
+
+
+def test_stage_failure_includes_error(log_path):
+    with pytest.raises(RuntimeError):
+        with structlog.stage("doomed_stage", case=7):
+            raise RuntimeError("kaput")
+    (ev,) = _events(log_path, "doomed_stage")
+    assert ev["ok"] is False and "kaput" in ev["error"] and ev["case"] == 7
+
+
+def test_run_id_defaults_to_process_uuid(log_path, monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_RUN_ID", raising=False)
+    rid = structlog.run_id()
+    assert rid and rid == structlog.run_id()  # stable within the process
+    monkeypatch.setenv("RAFT_TPU_RUN_ID", "pinned42")
+    assert structlog.run_id() == "pinned42"

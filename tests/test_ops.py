@@ -307,8 +307,13 @@ def test_structlog_events(tmp_path, monkeypatch):
     assert lines[0]["event"] == "unit_stage"
     assert lines[0]["ok"] is True and lines[0]["case"] == 3
     assert lines[0]["wall_s"] >= 0
+    # every record carries the pid/run_id telemetry stamps (PR 5)
+    import os as _os
+
     assert lines[1] == {"t": lines[1]["t"], "event": "custom",
+                        "pid": _os.getpid(), "run_id": lines[1]["run_id"],
                         "resid": 1.5e-3, "converged": True}
+    assert lines[0]["run_id"] == lines[1]["run_id"]
 
     # retargeting mid-process takes effect without a module reload
     dest2 = tmp_path / "log2.jsonl"
