@@ -25,3 +25,9 @@ python -m raft_tpu.analysis lint
 # status word must stay gather-free/callback-free and inside the
 # checked-in primitive budgets (raft_tpu/analysis/primitive_baseline.json)
 python -m raft_tpu.analysis contracts
+
+# AOT program-bank integrity: entries parse, payload checksums/sizes
+# match their metadata, no orphaned half-writes; stale entries (old
+# jax or source fingerprints) are reported but don't fail — `python -m
+# raft_tpu.aot gc` reclaims them.  Trivially clean on an empty bank.
+python -m raft_tpu.aot verify

@@ -1,0 +1,19 @@
+"""Ahead-of-time program bank: exported, versioned compiled programs.
+
+BENCH_r05 measured ``compile_s: 33.65`` against ``full_pipeline_s:
+2.21`` — every fresh process pays ~15x the work it compiles before the
+first sweep answers.  This package kills that cold start: compiled XLA
+executables are serialized into a versioned on-disk bank
+(:mod:`raft_tpu.aot.bank`), keyed so that a stale entry can never be
+executed, and loaded by the sweep funnel
+(:func:`raft_tpu.parallel.sweep._cached_jit`) *before* tracing — a
+warmed fresh process answers its first sweep in seconds with ZERO
+backend compilations (sentinel-verified,
+:mod:`raft_tpu.analysis.recompile`).
+
+``python -m raft_tpu.aot {warmup,list,verify,gc}`` is the operator
+surface; see the README "AOT program bank & warmup" section.
+"""
+
+from raft_tpu.aot.bank import (BankMissError, BankedProgram,  # noqa: F401
+                               compile_or_load)

@@ -1,0 +1,171 @@
+"""CLI for the AOT program bank.
+
+    python -m raft_tpu.aot warmup [--design YAML] [--n 512,8]
+                                  [--kinds cases,full,design]
+                                  [--out-keys PSD,X0,status]
+    python -m raft_tpu.aot list
+    python -m raft_tpu.aot verify
+    python -m raft_tpu.aot gc [--max-age-days D] [--all] [--dry-run]
+
+Exit codes: 0 clean, 1 problems (verify) / failed warmup, 2 usage.
+
+``list``/``verify``/``gc`` never initialize a jax backend (version
+fingerprints come from package metadata), so they are safe in CI and
+on hosts with a dead accelerator tunnel.  ``warmup`` runs real
+compilations: it pins the platform from ``RAFT_TPU_CLI_PLATFORM``
+(default cpu) unless ``--platform`` overrides it, and leaves x64 OFF
+by default — matching how the sweep consumers (bench, sweep_10k,
+serving workers) run; pass ``--x64`` only when the consumers enable
+x64 too (e.g. the parity test suite).  Warm with EXACTLY the
+platform, x64 mode, dtype policy and flags the serving process will
+run — all of them are part of the bank key, so a mismatch is a clean
+but total miss.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _cmd_warmup(args):
+    from raft_tpu.utils import config
+
+    platform = (args.platform if args.platform is not None
+                else config.get("CLI_PLATFORM"))
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    if args.x64:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+
+    from raft_tpu.aot import warmup
+
+    sizes = [int(s) for s in args.n.split(",") if s.strip()]
+    kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
+    out_keys = tuple(k.strip() for k in args.out_keys.split(",") if k.strip())
+    try:
+        reports = warmup.warmup_model(design=args.design, sizes=sizes,
+                                      kinds=kinds, out_keys=out_keys)
+    except ValueError as e:   # e.g. a typo'd --kinds entry
+        print(str(e), file=sys.stderr)
+        return 2
+    for r in reports:
+        how = ("already banked" if r["loaded"] and not r["compiled"]
+               else f"compiled {r['compiled']} program(s)")
+        print(f"warmup {r['kind']:<7} rows={r['rows']:<6} {how} "
+              f"in {r['wall_s']}s")
+    from raft_tpu.aot import bank
+
+    print(f"bank: {bank.bank_dir()}")
+    return 0
+
+
+def _fmt_age(created):
+    if not created:
+        return "?"
+    days = (time.time() - created) / 86400.0
+    return f"{days:.1f}d"
+
+
+def _cmd_list(_args):
+    from raft_tpu.aot import bank
+
+    rows = []
+    for key, meta, _mp, bin_path in bank.scan():
+        if meta is None:
+            rows.append((key, "?", "?", "?", "?", "CORRUPT/ORPHAN"))
+            continue
+        env = meta.get("environment") or {}
+        state = "stale" if bank.is_stale(meta) else "ok"
+        rows.append((key, meta.get("kind", "?"),
+                     f"{env.get('platform')}x{env.get('n_devices')}"
+                     + ("/x64" if env.get("x64") else ""),
+                     f"{(meta.get('payload_bytes') or 0) / 1e6:.1f}MB",
+                     _fmt_age(meta.get("created")), state))
+    if not rows:
+        print(f"bank empty: {bank.bank_dir()}")
+        return 0
+    widths = [max(len(str(r[i])) for r in rows) for i in range(6)]
+    hdr = ("key", "kind", "env", "size", "age", "state")
+    for r in (hdr,) + tuple(rows):
+        print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)))
+    print(f"{len(rows)} entr{'y' if len(rows) == 1 else 'ies'} in "
+          f"{bank.bank_dir()}")
+    return 0
+
+
+def _cmd_verify(_args):
+    from raft_tpu.aot import bank
+
+    problems, notes, n = bank.verify_bank()
+    for note in notes:
+        print(f"note: {note}")
+    for p in problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    if problems:
+        print(f"aot verify: {len(problems)} problem(s) across {n} "
+              f"entr{'y' if n == 1 else 'ies'}.", file=sys.stderr)
+        return 1
+    print(f"aot bank verified: {n} entr{'y' if n == 1 else 'ies'}, "
+          f"{len(notes)} stale, 0 problems ({bank.bank_dir()}).")
+    return 0
+
+
+def _cmd_gc(args):
+    from raft_tpu.aot import bank
+
+    s = bank.gc_bank(max_age_days=args.max_age_days,
+                     remove_all=args.all, dry_run=args.dry_run)
+    verb = "would remove" if args.dry_run else "removed"
+    print(f"aot gc: {verb} {s['removed']} entr"
+          f"{'y' if s['removed'] == 1 else 'ies'} "
+          f"({s['bytes_freed'] / 1e6:.1f}MB), kept {s['kept']}.")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m raft_tpu.aot")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("warmup", help="lower+compile+export the sweep "
+                                      "programs for a design")
+    p.add_argument("--design", default=None,
+                   help="design YAML (default: bundled spar_demo)")
+    p.add_argument("--n", default="8",
+                   help="comma list of batch sizes to warm (rounded up "
+                        "to the dp mesh-axis size)")
+    p.add_argument("--kinds", default=",".join(
+        ("cases", "full", "design")),
+        help="comma list of sweep kinds: cases,full,design")
+    p.add_argument("--out-keys", default="PSD,X0,status",
+                   help="out_keys of the warmed programs (include "
+                        "'status' to warm the health fold)")
+    p.add_argument("--platform", default=None,
+                   help="jax platform pin (default: RAFT_TPU_CLI_PLATFORM)")
+    p.add_argument("--x64", action="store_true",
+                   help="warm under jax_enable_x64 (only when the "
+                        "serving/sweep processes enable it too — x64 "
+                        "is part of the bank key)")
+
+    sub.add_parser("list", help="table of bank entries")
+    sub.add_parser("verify", help="integrity-check the bank (CI gate)")
+
+    p = sub.add_parser("gc", help="remove stale/orphaned/corrupt entries")
+    p.add_argument("--max-age-days", type=float, default=None)
+    p.add_argument("--all", action="store_true",
+                   help="empty the bank entirely")
+    p.add_argument("--dry-run", action="store_true")
+
+    args = ap.parse_args(argv)
+    cmd = {"warmup": _cmd_warmup, "list": _cmd_list,
+           "verify": _cmd_verify, "gc": _cmd_gc}[args.cmd]
+    return cmd(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

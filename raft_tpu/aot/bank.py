@@ -1,0 +1,630 @@
+"""The AOT program bank: serialize compiled XLA executables, reload
+them in a fresh process without tracing or compiling.
+
+Mechanism: ``jit(...).lower(*args).compile()`` produces a loaded
+executable that :mod:`jax.experimental.serialize_executable` can
+serialize/deserialize; deserialization emits NO
+``backend_compile`` monitoring event, so a bank hit is invisible to
+the recompile sentinel — exactly the property the cold-start budget
+(``RAFT_TPU_COMPILE_BUDGET``) asserts.
+
+Bank entries live under ``<RAFT_TPU_AOT_DIR>/v<FORMAT>/`` as a
+``<key>.json`` metadata sidecar plus a ``<key>.bin`` pickled payload.
+The key is a hash over everything that makes an executable valid to
+run:
+
+* the sweep memo key (kind, out_keys, case keys, mesh axis/device
+  layout, and the trace-time ``RAFT_TPU_*`` flags from
+  :func:`raft_tpu.parallel.sweep._flags_key`);
+* the input avals (tree structure + shape/dtype/weak-type of every
+  leaf) — a compiled program is shape-specialized;
+* the **environment fingerprint** — backend platform, local device
+  count/kind, ``jax_enable_x64`` — variants that legitimately coexist
+  in one bank (a CPU-warmed bank does not answer for a TPU process);
+* the **version fingerprint** — jax/jaxlib versions and a content hash
+  of every ``raft_tpu`` source file (the cheap, trace-free stand-in
+  for the jaxpr fingerprint: any code change invalidates the entry and
+  forces a clean re-lower, never a stale execution).  The exact
+  StableHLO hash of the lowered module is recorded in the metadata at
+  store time for audit (``python -m raft_tpu.aot list/verify``).
+
+Because staleness is part of the key, the load path never has to
+*judge* an entry — a stale one simply never matches and becomes gc
+fodder (``python -m raft_tpu.aot gc``).  Corruption is caught by a
+stored payload sha256 checked before unpickling.
+
+Modes (``RAFT_TPU_AOT``, re-read per dispatch like every flag):
+
+* ``off`` — bank untouched; plain jit dispatch (the default);
+* ``load`` — consult the bank first; on a miss, lower + compile as
+  usual and export the result so the NEXT process loads it;
+* ``require`` — consult the bank; a miss raises
+  :class:`BankMissError` (or logs and compiles, with
+  ``RAFT_TPU_AOT_MISS=compile``) — serving mode, where an unwarmed
+  key is an operational bug, not a 33-second stall.
+
+Every load/miss/store feeds the :mod:`raft_tpu.obs.metrics` registry
+(``aot_programs_loaded`` / ``aot_bank_misses`` /
+``aot_programs_compiled`` / ``aot_bank_errors``) and the structured
+log (events ``aot_load`` / ``aot_miss`` / ``aot_store`` /
+``aot_error``), so sweep manifests and the bench breakdown can state
+"N bank loads, 0 compiles" instead of inferring it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import time
+
+from raft_tpu.obs import metrics
+from raft_tpu.utils import config
+from raft_tpu.utils.structlog import log_event
+
+#: bump when the on-disk layout or payload format changes — old
+#: directories are simply never read (and ``gc`` removes them)
+BANK_FORMAT = 1
+
+_META_SUFFIX = ".json"
+_BIN_SUFFIX = ".bin"
+
+
+class BankMissError(RuntimeError):
+    """``RAFT_TPU_AOT=require`` and the program bank has no entry for
+    this key (run ``python -m raft_tpu.aot warmup`` first, or warm the
+    bank with one ``RAFT_TPU_AOT=load`` run of the same workload)."""
+
+
+def mode():
+    """Current bank mode (off | load | require), re-read per call."""
+    return config.get("AOT")
+
+
+def bank_dir():
+    """The versioned bank directory for the current format."""
+    return os.path.join(config.get("AOT_DIR"), f"v{BANK_FORMAT}")
+
+
+# --------------------------------------------------------------- fingerprints
+
+_CODE_FP_CACHE: dict = {}
+
+
+def code_fingerprint():
+    """Content hash over every ``raft_tpu`` source file.
+
+    The trace-free proxy for the jaxpr fingerprint: any edit anywhere
+    in the package changes the key, so a bank entry can never serve a
+    program the current code would not have produced.  Coarse on
+    purpose — a false invalidation costs one re-lower, a false hit
+    would silently run old physics.  Cached per process (~100 files,
+    single-digit milliseconds)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root in _CODE_FP_CACHE:
+        return _CODE_FP_CACHE[root]
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(path, root).encode())
+            with open(path, "rb") as f:
+                h.update(f.read())
+    fp = h.hexdigest()[:16]
+    _CODE_FP_CACHE[root] = fp
+    return fp
+
+
+def content_fingerprint(obj):
+    """Deterministic hash of a nested plain-data structure (dicts,
+    lists/tuples, scalars, strings, numpy/jax arrays) — the *program
+    identity* stamp.
+
+    The bank key's flag/aval/code fingerprints cover everything except
+    the data a traced closure baked in as constants: two models whose
+    sweeps share kind/out_keys/mesh/shapes would otherwise collide on
+    one entry and silently serve each other's physics.  Evaluator
+    factories therefore stamp ``evaluate._raft_program_key`` with a
+    hash of the design content (plus factory arguments), and the sweep
+    funnel refuses to bank closures that carry no stamp."""
+    import numpy as np
+
+    h = hashlib.sha256()
+
+    def walk(o):
+        if o is None or isinstance(o, (bool, int, float, complex,
+                                       str, bytes)):
+            h.update(repr(o).encode())
+        elif isinstance(o, np.ndarray):
+            if o.dtype == object:
+                # tobytes() on an object array would hash the POINTERS
+                # — different every process, a key that can never hit
+                walk(o.tolist())
+            else:
+                h.update(str((o.dtype, o.shape)).encode())
+                h.update(np.ascontiguousarray(o).tobytes())
+        elif isinstance(o, np.generic):
+            h.update(repr(o.item()).encode())
+        elif isinstance(o, dict):
+            h.update(b"{")
+            for k in sorted(o, key=repr):
+                walk(k)
+                walk(o[k])
+            h.update(b"}")
+        elif isinstance(o, (list, tuple)):
+            h.update(b"[")
+            for v in o:
+                walk(v)
+            h.update(b"]")
+        else:
+            # arbitrary objects: np.asarray would "succeed" as a 0-d
+            # object array (pointer bytes again), so only numeric
+            # coercions count; everything else degrades to type
+            # identity — deterministic, but blind to content, so stamp
+            # explicit keys for such objects
+            try:
+                arr = np.asarray(o)
+            except Exception:
+                arr = None
+            if arr is not None and arr.dtype != object:
+                walk(arr)
+            else:
+                h.update(repr(type(o)).encode())
+
+    walk(obj)
+    return h.hexdigest()[:16]
+
+
+def file_fingerprint(path):
+    """Content hash of one source file — for traced code living
+    OUTSIDE the ``raft_tpu`` package (bench.py, sweep_10k.py, user
+    sweep scripts), which :func:`code_fingerprint` cannot see: mix
+    this into the program stamp so an edit there misses the bank."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def program_key(evaluate):
+    """The evaluator's bank identity stamp, or None when it has none
+    (unstamped closures are never banked — see
+    :func:`content_fingerprint`)."""
+    return getattr(evaluate, "_raft_program_key", None)
+
+
+def version_fingerprint():
+    """Toolchain identity: entries from another jax/jaxlib or another
+    state of the raft_tpu sources are dead (gc'd), not variants."""
+    import jax
+    import jaxlib
+
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__,
+            "code": code_fingerprint(), "format": BANK_FORMAT}
+
+
+def environment_fingerprint():
+    """Runtime identity: legitimate coexisting variants of one bank
+    (platform, device topology, x64 mode) — never grounds for gc."""
+    import jax
+
+    devs = jax.devices()
+    return {"platform": devs[0].platform,
+            "device_kind": devs[0].device_kind,
+            "n_devices": len(devs),
+            "x64": bool(jax.config.jax_enable_x64)}
+
+
+def _aval_sig(args):
+    """Canonical signature of the dispatch arguments: tree structure
+    plus (shape, dtype, weak_type) per leaf.  Compiled executables are
+    specialized to exactly this."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = tuple(
+        (str(getattr(x, "shape", ())), str(getattr(x, "dtype", type(x))),
+         bool(getattr(getattr(x, "aval", None), "weak_type", False)))
+        for x in leaves)
+    return (str(treedef), sig)
+
+
+def entry_key(kind, memo_key, args):
+    """(hash, metadata) for one bank entry.  The hash covers every
+    validity condition, so lookup is a pure existence check."""
+    ver = version_fingerprint()
+    env = environment_fingerprint()
+    treedef, avals = _aval_sig(args)
+    ident = repr((BANK_FORMAT, kind, memo_key, treedef, avals,
+                  sorted(ver.items()), sorted(env.items())))
+    key = hashlib.sha256(ident.encode()).hexdigest()[:24]
+    meta = {
+        "format": BANK_FORMAT,
+        "kind": kind,
+        "key": key,
+        "memo_key": repr(memo_key),
+        "treedef": treedef,
+        "avals": [list(a) for a in avals],
+        "version": ver,
+        "environment": env,
+    }
+    return key, meta
+
+
+def _paths(key):
+    d = bank_dir()
+    return (os.path.join(d, key + _META_SUFFIX),
+            os.path.join(d, key + _BIN_SUFFIX))
+
+
+def _atomic_write(path, data):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+# ------------------------------------------------------------------ load/store
+
+_NATIVE_CALLBACKS_ARMED = [False]
+
+
+def _arm_native_callbacks():
+    """Bind the CPU custom-call kernels a deserialized executable may
+    reference.  jax registers the LAPACK/BLAS custom-call *targets* at
+    ``jaxlib.lapack`` import, but the kernel function pointers behind
+    them are only bound by ``_lapack.initialize()`` — which normally
+    happens lazily at LOWERING time (``prepare_lapack_call``).  A bank
+    hit never lowers anything, so a fresh process would execute e.g.
+    ``blas_dtrsm`` through an uninitialized trampoline and segfault
+    (observed: any program containing ``jnp.linalg.solve``).  Arm them
+    once, before the first deserialization; a few ms, idempotent."""
+    if _NATIVE_CALLBACKS_ARMED[0]:
+        return
+    try:
+        import jaxlib.lapack  # noqa: F401  (registers the targets)
+        from jaxlib.cpu import _lapack
+
+        _lapack.initialize()  # binds the BLAS/LAPACK kernel pointers
+    except Exception:  # other backends / future jaxlib layouts
+        pass
+    _NATIVE_CALLBACKS_ARMED[0] = True
+
+
+def peek(kind, memo_key, args):
+    """The entry's metadata dict when the bank holds this program,
+    else None — a pure file check (no deserialization, no counters),
+    for callers budgeting wall time around a potential miss (e.g. the
+    bench breakdown heuristics)."""
+    key, _ = entry_key(kind, memo_key, args)
+    meta_path, bin_path = _paths(key)
+    if not (os.path.exists(meta_path) and os.path.exists(bin_path)):
+        return None
+    try:
+        with open(meta_path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def lookup(kind, memo_key, args):
+    """Deserialize the banked executable for (kind, memo_key, avals),
+    or None on miss.  Corrupt/unreadable entries are logged, counted
+    (``aot_bank_errors``) and treated as misses — never a crash."""
+    key, _ = entry_key(kind, memo_key, args)
+    meta_path, bin_path = _paths(key)
+    if not (os.path.exists(meta_path) and os.path.exists(bin_path)):
+        return None
+    t0 = time.perf_counter()
+    try:
+        with open(meta_path, encoding="utf-8") as f:
+            meta = json.load(f)
+        with open(bin_path, "rb") as f:
+            buf = f.read()
+        if meta.get("payload_sha256") != hashlib.sha256(buf).hexdigest():
+            raise ValueError("payload sha256 mismatch (truncated or "
+                             "externally modified .bin)")
+        from jax.experimental import serialize_executable
+
+        _arm_native_callbacks()
+        compiled = serialize_executable.deserialize_and_load(
+            *pickle.loads(buf))
+    except Exception as e:  # corrupt entry: miss, loudly
+        metrics.counter("aot_bank_errors").inc()
+        log_event("aot_error", kind=kind, key=key, error=repr(e)[:300])
+        return None
+    wall = time.perf_counter() - t0
+    metrics.counter("aot_programs_loaded").inc()
+    log_event("aot_load", kind=kind, key=key, bytes=len(buf),
+              wall_s=round(wall, 4))
+    return compiled
+
+
+def _compile_fresh(lowered):
+    """Compile bypassing the XLA persistent disk cache.
+
+    An executable *retrieved* from the disk cache re-serializes into a
+    payload missing its symbol definitions (observed on jaxlib 0.4.36
+    CPU: a later deserialize fails with ``INTERNAL: Symbols not
+    found``) — storing one would mint a poison bank entry.  One full
+    compile is the honest price of a durable artifact; the entry then
+    supersedes the disk cache for every future process."""
+    import jax
+
+    if not jax.config.jax_enable_compilation_cache:
+        return lowered.compile()
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        return lowered.compile()
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
+
+
+def store(kind, memo_key, args, lowered, compiled, compile_s):
+    """Export a freshly-compiled executable into the bank (best
+    effort: serialization failures are logged, never fatal).  The
+    ``.bin`` payload lands before its ``.json`` sidecar — the loader
+    requires both, so a crash between the writes leaves an orphan the
+    ``gc``/``verify`` CLIs surface, not a half-entry that loads."""
+    key, meta = entry_key(kind, memo_key, args)
+    try:
+        from jax.experimental import serialize_executable
+
+        buf = pickle.dumps(serialize_executable.serialize(compiled))
+        # round-trip self-check: a payload that cannot deserialize NOW
+        # (e.g. the executable secretly came from the XLA disk cache)
+        # must never be committed for a future process to trip over
+        serialize_executable.deserialize_and_load(*pickle.loads(buf))
+        try:
+            hlo_hash = hashlib.sha256(
+                lowered.as_text().encode()).hexdigest()[:16]
+        except Exception:
+            hlo_hash = None
+        meta.update(payload_sha256=hashlib.sha256(buf).hexdigest(),
+                    payload_bytes=len(buf),
+                    stablehlo_sha256=hlo_hash,
+                    compile_s=round(float(compile_s), 3),
+                    created=time.time(),
+                    raft_flags={k: config.get(k) for k in
+                                ("SOLVER", "FIXED_POINT", "SCAN_CHUNK",
+                                 "DTYPE", "COND_CHECK", "COND_THRESHOLD",
+                                 "ITER_SCALE")})
+        os.makedirs(bank_dir(), exist_ok=True)
+        meta_path, bin_path = _paths(key)
+        _atomic_write(bin_path, buf)
+        _atomic_write(meta_path,
+                      (json.dumps(meta, indent=1, sort_keys=True) + "\n")
+                      .encode())
+    except Exception as e:
+        metrics.counter("aot_bank_errors").inc()
+        log_event("aot_error", kind=kind, key=key, error=repr(e)[:300])
+        return None
+    log_event("aot_store", kind=kind, key=key, bytes=len(buf),
+              compile_s=round(float(compile_s), 3))
+    return bin_path
+
+
+def _on_miss(kind, memo_key, args):
+    """Account for a bank miss; in ``require`` mode this is where the
+    sweep fails loudly (or, flag-controlled, falls back to a compile)."""
+    m = mode()
+    key, _ = entry_key(kind, memo_key, args)
+    metrics.counter("aot_bank_misses").inc()
+    log_event("aot_miss", kind=kind, key=key, mode=m)
+    if m == "require" and config.get("AOT_MISS") == "error":
+        raise BankMissError(
+            f"AOT bank miss for {kind!r} key {key} under "
+            f"RAFT_TPU_AOT=require (bank: {bank_dir()}).  Warm the bank "
+            "with `python -m raft_tpu.aot warmup` or one "
+            "RAFT_TPU_AOT=load run of this workload; set "
+            "RAFT_TPU_AOT_MISS=compile to log and fall back instead.")
+
+
+# ------------------------------------------------------------------ dispatch
+
+def compile_or_load(fn, args, kind, memo_key=(), bankable=True):
+    """AOT-compile ``fn`` for ``args`` through the bank.
+
+    Returns ``(compiled, loaded, seconds)``: a ready-to-call loaded
+    executable, whether it came from the bank, and the wall time of the
+    load or lower+compile.  Used directly by ``bench.py`` (whose
+    programs don't route through the sweep memo) and by
+    :class:`BankedProgram` for everything that does.  ``bankable=False``
+    keeps the explicit lower+compile+count behavior but never touches
+    the bank (programs whose closed-over content has no identity in
+    ``memo_key``)."""
+    t0 = time.perf_counter()
+    m = mode() if bankable else "off"
+    if m != "off":
+        exe = lookup(kind, memo_key, args)
+        if exe is not None:
+            return exe, True, time.perf_counter() - t0
+        _on_miss(kind, memo_key, args)
+    lowered = fn.lower(*args)
+    # a miss that will be exported must compile for real — a disk-cache
+    # retrieval is not serializable (see _compile_fresh)
+    compiled = _compile_fresh(lowered) if m != "off" else lowered.compile()
+    dt = time.perf_counter() - t0
+    metrics.counter("aot_programs_compiled").inc()
+    if m != "off":
+        store(kind, memo_key, args, lowered, compiled, dt)
+    return compiled, False, dt
+
+
+class BankedProgram:
+    """The callable :func:`raft_tpu.parallel.sweep._cached_jit` memoizes:
+    a jitted sweep wrapper fronted by the program bank.
+
+    * ``RAFT_TPU_AOT=off``: transparent — dispatches the plain jitted
+      function (built once), byte-for-byte the pre-bank behavior.
+    * otherwise: per input-aval signature, the first dispatch loads the
+      banked executable (no trace, no compile) or — on a miss —
+      lowers, compiles, executes AND exports, so the next process
+      loads.  Executables are cached in-process per aval signature
+      (shard tails dispatch a second, smaller-batch program).
+    """
+
+    def __init__(self, kind, memo_key, build, bankable=True):
+        self._kind = kind
+        self._memo_key = memo_key
+        self._build = build
+        self._bankable = bankable
+        self._warned_unbankable = False
+        self._fn = None      # the jitted wrapper, built at most once
+        self._execs = {}     # aval signature -> loaded executable
+
+    def _jit(self):
+        if self._fn is None:
+            self._fn = self._build()
+        return self._fn
+
+    def __call__(self, *args):
+        if mode() == "off":
+            return self._jit()(*args)
+        if not self._bankable:
+            # a closure with no program-identity stamp cannot be
+            # banked safely (cross-process keys would collide on
+            # closed-over content) — say so once, then dispatch plain
+            if not self._warned_unbankable:
+                self._warned_unbankable = True
+                log_event("aot_unbankable", kind=self._kind)
+            return self._jit()(*args)
+        sig = _aval_sig(args)
+        exe = self._execs.get(sig)
+        if exe is None:
+            exe, _, _ = compile_or_load(self._jit(), args,
+                                        self._kind, self._memo_key)
+            self._execs[sig] = exe
+        return exe(*args)
+
+
+# ------------------------------------------------------- bank maintenance
+
+def stray_tmp_files():
+    """Leftover ``*.tmp`` files from interrupted :func:`_atomic_write`
+    calls (a crash between write and ``os.replace``): never valid,
+    invisible to :func:`scan`'s key pairing — ``verify`` notes them,
+    ``gc`` removes them."""
+    d = bank_dir()
+    if not os.path.isdir(d):
+        return []
+    return [os.path.join(d, n) for n in sorted(os.listdir(d))
+            if n.endswith(".tmp")]
+
+
+def scan():
+    """Yield ``(key, meta | None, meta_path, bin_path)`` for every
+    entry in the bank directory; ``meta`` is None when the sidecar is
+    missing or unparseable (orphan/corrupt)."""
+    d = bank_dir()
+    if not os.path.isdir(d):
+        return
+    names = sorted(os.listdir(d))
+    keys = {n[:-len(_META_SUFFIX)] for n in names if n.endswith(_META_SUFFIX)}
+    keys |= {n[:-len(_BIN_SUFFIX)] for n in names if n.endswith(_BIN_SUFFIX)}
+    for key in sorted(keys):
+        meta_path, bin_path = _paths(key)
+        meta = None
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path, encoding="utf-8") as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                meta = None
+        yield key, meta, meta_path, bin_path
+
+
+def is_stale(meta):
+    """True when an entry's version fingerprint no longer matches the
+    running toolchain/sources (it can never be loaded again)."""
+    return meta is None or meta.get("version") != version_fingerprint()
+
+
+def verify_bank():
+    """Integrity check for the bank directory (the ``verify`` CLI and
+    the lint gate).  Returns ``(problems, notes, n_entries)``:
+    ``problems`` fail CI (unparseable metadata, missing/orphaned/
+    truncated payloads, checksum mismatches); ``notes`` are benign
+    observations (stale entries awaiting gc, foreign-environment
+    variants)."""
+    problems, notes = [], []
+    n = 0
+    for key, meta, meta_path, bin_path in scan():
+        n += 1
+        if meta is None:
+            if os.path.exists(meta_path):
+                problems.append(f"{key}: metadata sidecar unparseable")
+            else:
+                problems.append(f"{key}: orphan payload (no .json sidecar "
+                                "— interrupted store; gc removes it)")
+            continue
+        if not os.path.exists(bin_path):
+            problems.append(f"{key}: metadata without payload (.bin missing)")
+            continue
+        size = os.path.getsize(bin_path)
+        if size != meta.get("payload_bytes"):
+            problems.append(
+                f"{key}: payload is {size} bytes, metadata promises "
+                f"{meta.get('payload_bytes')} (truncated write?)")
+            continue
+        with open(bin_path, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
+        if digest != meta.get("payload_sha256"):
+            problems.append(f"{key}: payload sha256 mismatch")
+            continue
+        if is_stale(meta):
+            got = (meta.get("version") or {})
+            notes.append(
+                f"{key}: stale ({meta.get('kind')}; jax "
+                f"{got.get('jax')}, code {got.get('code')}) — "
+                "never loaded by this toolchain; `gc` reclaims it")
+    for tmp in stray_tmp_files():
+        # a .tmp may also be another process mid-store, so it is a
+        # note, not a CI failure; gc reclaims the dead ones
+        notes.append(f"{os.path.basename(tmp)}: interrupted write "
+                     "(or a store in progress); `gc` reclaims it")
+    return problems, notes, n
+
+
+def gc_bank(max_age_days=None, remove_all=False, dry_run=False):
+    """Remove dead entries: stale version fingerprints, orphans,
+    corrupt sidecars, and (optionally) anything older than
+    ``max_age_days``.  Foreign *environment* variants (other platform/
+    topology/x64) are kept — they are live entries for other processes.
+    Returns a summary dict."""
+    removed, kept, freed = [], 0, 0
+    now = time.time()
+    for key, meta, meta_path, bin_path in scan():
+        dead = remove_all or is_stale(meta)
+        if (not dead and max_age_days is not None
+                and now - (meta.get("created") or 0) > max_age_days * 86400):
+            dead = True
+        if not dead:
+            kept += 1
+            continue
+        for p in (meta_path, bin_path):
+            if os.path.exists(p):
+                freed += os.path.getsize(p)
+                if not dry_run:
+                    os.remove(p)
+        removed.append(key)
+    for tmp in stray_tmp_files():   # interrupted-write leftovers
+        try:
+            freed += os.path.getsize(tmp)
+            if not dry_run:
+                os.remove(tmp)
+            removed.append(os.path.basename(tmp))
+        except OSError:
+            pass
+    summary = dict(removed=len(removed), kept=kept, bytes_freed=freed,
+                   dry_run=bool(dry_run))
+    log_event("aot_gc", **summary)
+    return summary

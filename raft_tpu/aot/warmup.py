@@ -1,0 +1,144 @@
+"""Warm the AOT program bank before anyone is waiting on it.
+
+Builds a model once and dispatches each requested sweep kind through
+the SAME funnel production uses (:mod:`raft_tpu.parallel.sweep`), with
+``RAFT_TPU_AOT`` forced to at least ``load`` — so every program the
+sweep memo would build is lowered, compiled and exported now, and a
+fresh serving/worker process later answers its first sweep from the
+bank in seconds.
+
+The warmed kinds map onto the four traced entry points the jaxpr
+contract suite guards (:mod:`raft_tpu.analysis.jaxpr_contracts`):
+
+* ``cases``  — :func:`raft_tpu.api.make_case_evaluator` through
+  :func:`~raft_tpu.parallel.sweep.sweep_cases` (the spar-dynamics
+  chain: statics, excitation, drag fixed point, impedance solves);
+* ``full``   — :func:`raft_tpu.api.make_full_evaluator` through
+  :func:`~raft_tpu.parallel.sweep.sweep_cases_full` (full physics,
+  operating turbine);
+* ``design`` — :func:`raft_tpu.api.make_design_evaluator` through
+  ``sweep_cases_full`` (the design-sweep axis);
+* the solver-health status fold rides along in every kind: ``status``
+  is warmed as a first-class out_key (default out_keys include it).
+
+Batch sizes are per-program: a 10k/512 sweep dispatches a 512-row
+program plus one padded tail, so warm the sizes you will serve
+(``--n 512,8``).  Custom closures (e.g. ``sweep_10k.py``'s per-design
+summary evaluator) self-warm instead: their first ``RAFT_TPU_AOT=load``
+run exports, every later process loads.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import numpy as np
+
+DEFAULT_OUT_KEYS = ("PSD", "X0", "status")
+DEFAULT_KINDS = ("cases", "full", "design")
+
+
+@contextlib.contextmanager
+def _force_load_mode():
+    """Ensure the bank is armed for the duration of the warmup (a
+    warmup under ``RAFT_TPU_AOT=off`` would compile and export
+    nothing; ``require`` would refuse the very misses it exists to
+    fill)."""
+    from raft_tpu.utils import config
+
+    env = config.env_name("AOT")
+    old = os.environ.get(env)
+    if config.get("AOT") != "load":
+        os.environ[env] = "load"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop(env, None)
+        else:
+            os.environ[env] = old
+
+
+def _round_up(n, multiple):
+    return int(-(-n // multiple) * multiple)
+
+
+def warmup_model(design=None, sizes=(8,), kinds=DEFAULT_KINDS,
+                 out_keys=DEFAULT_OUT_KEYS, mesh=None):
+    """Warm the bank for one design.  Returns a list of per-program
+    report dicts (kind, rows, loaded/compiled, seconds)."""
+    import jax
+
+    import raft_tpu
+    from raft_tpu import api
+    from raft_tpu.obs import metrics
+    from raft_tpu.parallel.sweep import make_mesh, sweep_cases, \
+        sweep_cases_full
+    from raft_tpu.utils.devices import enable_compile_cache
+    from raft_tpu.utils.structlog import log_event
+
+    unknown = set(kinds) - set(DEFAULT_KINDS)
+    if unknown:
+        # a typo'd kind must not report a successful no-op warmup — the
+        # serving replica would discover the cold bank as BankMissError
+        raise ValueError(f"unknown warmup kind(s) {sorted(unknown)}; "
+                         f"choose from {list(DEFAULT_KINDS)}")
+    enable_compile_cache()
+    if design is None:
+        design = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "designs", "spar_demo.yaml")
+    model = raft_tpu.Model(design)
+    if mesh is None:
+        mesh = make_mesh()
+    dp = mesh.shape.get("dp", mesh.devices.size)
+
+    evaluators = {}
+    if "cases" in kinds:
+        evaluators["cases"] = api.make_case_evaluator(model)
+    if "full" in kinds:
+        evaluators["full"] = api.make_full_evaluator(model)
+    if "design" in kinds:
+        evaluators["design"] = api.make_design_evaluator(model)
+
+    reports = []
+    with _force_load_mode():
+        for kind, evaluate in evaluators.items():
+            for n in sizes:
+                rows = _round_up(int(n), dp)
+                rng = np.random.default_rng(0)
+                Hs = rng.uniform(2.0, 8.0, rows)
+                Tp = rng.uniform(6.0, 14.0, rows)
+                beta = rng.uniform(-0.5, 0.5, rows)
+                c0 = {k: metrics.counter(k).value for k in
+                      ("aot_programs_loaded", "aot_programs_compiled")}
+                t0 = time.perf_counter()
+                if kind == "cases":
+                    out = sweep_cases(evaluate, Hs, Tp, beta, mesh=mesh,
+                                      out_keys=out_keys)
+                elif kind == "full":
+                    out = sweep_cases_full(
+                        evaluate,
+                        {"wind_speed": rng.uniform(4.0, 24.0, rows),
+                         "Hs": Hs, "Tp": Tp, "beta_deg": beta * 57.3},
+                        mesh=mesh, out_keys=out_keys)
+                else:  # design
+                    out = sweep_cases_full(
+                        evaluate,
+                        {"Hs": Hs, "Tp": Tp, "beta": beta,
+                         "Cd_scale": rng.uniform(0.9, 1.1, rows)},
+                        mesh=mesh, out_keys=out_keys)
+                jax.block_until_ready(out)
+                wall = time.perf_counter() - t0
+                rep = dict(
+                    kind=kind, rows=rows, wall_s=round(wall, 2),
+                    loaded=metrics.counter("aot_programs_loaded").value
+                    - c0["aot_programs_loaded"],
+                    compiled=metrics.counter("aot_programs_compiled").value
+                    - c0["aot_programs_compiled"])
+                log_event("aot_warmup", kind=kind, n=rows,
+                          loaded=rep["loaded"], compiled=rep["compiled"],
+                          wall_s=rep["wall_s"])
+                reports.append(rep)
+    return reports

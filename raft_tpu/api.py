@@ -54,6 +54,22 @@ def _case_status(st_status, dyn_diag, X0, Xi, input_clipped=False):
     return jnp.asarray(status, dtype=jnp.int32)
 
 
+def _stamp_program_key(evaluate, factory, model, *extra):
+    """Stamp the evaluator's AOT-bank identity
+    (``evaluate._raft_program_key``): factory name + a content hash of
+    the design dict + the trace-shaping factory arguments.  The sweep
+    funnel (:func:`raft_tpu.parallel.sweep._cached_jit`) banks only
+    stamped closures — without the stamp, nothing in the bank key
+    distinguishes the constants a trace baked in, and two designs
+    could collide on one exported program
+    (:mod:`raft_tpu.aot.bank`)."""
+    from raft_tpu.aot.bank import content_fingerprint
+
+    evaluate._raft_program_key = (
+        factory, content_fingerprint((model.design, extra)))
+    return evaluate
+
+
 def make_design_evaluator(model):
     """Build ``evaluate(params) -> outputs`` with traced *design*
     parameters — the 10k-design-sweep axis of the north star.
@@ -149,7 +165,7 @@ def make_design_evaluator(model):
             status=_case_status(st_status, dyn_diag, X0, Xi),
         )
 
-    return evaluate
+    return _stamp_program_key(evaluate, "design_evaluator", model)
 
 
 def case_to_traced(case, nWaves=1):
@@ -630,7 +646,8 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
         )
 
     evaluate.geometry_constants = geometry_constants
-    return evaluate
+    return _stamp_program_key(evaluate, "full_evaluator", model,
+                              nWaves, geometry, turb_static)
 
 
 def make_farm_evaluator(model, nWaves=1, turb_static=None):
@@ -834,7 +851,8 @@ def make_farm_evaluator(model, nWaves=1, turb_static=None):
                     n_iter_drag=jnp.stack(iters),
                     status=status)
 
-    return evaluate
+    return _stamp_program_key(evaluate, "farm_evaluator", model,
+                              nWaves, turb_static)
 
 
 def flexible_struct_params(model):
@@ -1050,7 +1068,8 @@ def make_flexible_evaluator(model, nWaves=1, turb_static=None,
                     status=_case_status(st_status, dyn_diag, X0, Xi,
                                         input_clipped=input_clipped))
 
-    return evaluate
+    return _stamp_program_key(evaluate, "flexible_evaluator", model,
+                              nWaves, geometry, turb_static)
 
 
 def make_case_evaluator(model, n_stat_iter=12):
@@ -1126,4 +1145,5 @@ def make_case_evaluator(model, n_stat_iter=12):
                     n_iter_drag=dyn_diag["n_iter_drag"],
                     status=_case_status(st_status, dyn_diag, X0, Xi))
 
-    return evaluate
+    return _stamp_program_key(evaluate, "case_evaluator", model,
+                              n_stat_iter)

@@ -49,6 +49,30 @@ def run_farm(input_file, save_csv=None):
     return model
 
 
+def warmup(input_file=None, sizes=(8,), kinds=("cases", "full", "design"),
+           out_keys=("PSD", "X0", "status")):
+    """Warm the AOT program bank for a design before serving it.
+
+    The driver-level face of ``python -m raft_tpu.aot warmup``: builds
+    the model once and pushes every requested sweep kind through the
+    production dispatch funnel under ``RAFT_TPU_AOT=load``, so each
+    program is lowered, compiled and exported to the bank
+    (``RAFT_TPU_AOT_DIR``).  A subsequent fresh process — a worker
+    joining mid-sweep, a serving replica, the next bench round — then
+    answers its first sweep from deserialized executables with zero
+    backend compilations (run it under ``RAFT_TPU_AOT=require`` +
+    ``RAFT_TPU_COMPILE_BUDGET=0`` to make that an enforced invariant).
+
+    sizes : batch sizes to warm, one program each (warm the shard
+        sizes you will dispatch; tail shards pad to the device count).
+    Returns the per-program warmup reports (kind, rows,
+    loaded/compiled, seconds)."""
+    from raft_tpu.aot.warmup import warmup_model
+
+    return warmup_model(design=input_file, sizes=sizes, kinds=kinds,
+                        out_keys=out_keys)
+
+
 def save_responses(model, path):
     """Write per-case channel statistics to CSV (saveResponses analog)."""
     rows = ["case,fowt,channel,avg,std,max,min"]

@@ -104,7 +104,42 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
     "sweep_program_built": (
         ("kind", "out_keys"),
         "a sweep jit wrapper was built fresh (first call for this memo "
-        "key; the next dispatch traces + compiles)"),
+        "key; the next dispatch loads from the AOT bank or "
+        "traces + compiles)"),
+    # ------------------------------------------------- AOT program bank
+    "aot_load": (
+        ("kind", "key", "bytes", "wall_s"),
+        "a banked executable was deserialized and dispatched — no "
+        "trace, no XLA compilation (raft_tpu.aot.bank)"),
+    "aot_miss": (
+        ("kind", "key", "mode"),
+        "no bank entry for this program key; 'require' mode raises "
+        "BankMissError here unless RAFT_TPU_AOT_MISS=compile"),
+    "aot_store": (
+        ("kind", "key", "bytes", "compile_s"),
+        "a freshly-compiled program was exported into the bank for "
+        "the next process"),
+    "aot_unbankable": (
+        ("kind",),
+        "a sweep closure carries no program-identity stamp "
+        "(_raft_program_key) and is dispatched without the bank — "
+        "stamp it (see README) to make it warm-loadable"),
+    "aot_error": (
+        ("error", "kind?", "key?"),
+        "a bank entry could not be serialized/deserialized (corrupt, "
+        "truncated, backend refuses) — logged and treated as a miss, "
+        "never fatal"),
+    "aot_gc": (
+        ("removed", "kept", "bytes_freed", "dry_run"),
+        "bank garbage collection removed stale/orphaned entries "
+        "(python -m raft_tpu.aot gc)"),
+    "aot_warmup": (
+        ("kind", "n", "loaded", "compiled", "wall_s"),
+        "one warmup sweep dispatched (python -m raft_tpu.aot warmup)"),
+    "compile_budget_exceeded": (
+        ("count", "budget", "action"),
+        "a backend compilation exceeded RAFT_TPU_COMPILE_BUDGET; "
+        "action 'error' raised RecompilationError at the dispatch"),
 }
 
 

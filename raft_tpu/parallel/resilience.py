@@ -372,7 +372,16 @@ def _rung_flags(rung):
     its own program and the base program stays cached."""
     ambient = max(int(config.get("ITER_SCALE")), 1)
     flags = {"ITER_SCALE":
-             str(ambient * max(int(config.get("ESCALATE_ITER_SCALE")), 2))}
+             str(ambient * max(int(config.get("ESCALATE_ITER_SCALE")), 2)),
+             # a rung's flag flip changes the AOT-bank key, and warmup
+             # does not mint rung-variant programs — under the serving
+             # config (RAFT_TPU_AOT=require + RAFT_TPU_COMPILE_BUDGET=0)
+             # the rung's first re-solve would miss the bank and raise
+             # instead of healing the row.  Escalation is a rare
+             # solo-row recovery path where availability beats
+             # cold-start purity: rungs may always compile (and, in
+             # load/require mode, export — the NEXT rung hit loads).
+             "AOT_MISS": "compile", "COMPILE_BUDGET": "-1"}
     if rung == "f64_cpu":
         flags["DTYPE"] = "float64"
     old = {}

@@ -53,7 +53,8 @@ def _flags_key():
 
 
 def _cached_jit(evaluate, key, build):
-    """The jitted wrapper for (evaluate, key), built at most once.
+    """The dispatchable sweep program for (evaluate, key), built at
+    most once and fronted by the AOT program bank.
 
     `jax.jit(vmap(...))` built inside the sweep call would be a FRESH
     function object every invocation, so a second identical sweep
@@ -65,28 +66,52 @@ def _cached_jit(evaluate, key, build):
     module-level cache keyed on the evaluator would pin its closed-over
     model build tensors for process lifetime).
 
+    What the memo holds is a :class:`raft_tpu.aot.bank.BankedProgram`:
+    under ``RAFT_TPU_AOT=off`` it is a transparent shim over the
+    jitted wrapper; under ``load``/``require`` it consults the on-disk
+    program bank BEFORE tracing — a warmed fresh process dispatches
+    its first sweep from a deserialized executable with zero backend
+    compilations, and a miss exports the freshly-compiled program for
+    the next process (see :mod:`raft_tpu.aot.bank`).
+
     Trace-once contract: an evaluator is traced at most once per
     (out_keys, mesh, trace-time flags) key — closed-over state mutated
     AFTER the first sweep is not picked up (build a fresh evaluator, or
-    ``del evaluate._raft_sweep_jit`` to force a re-trace)."""
+    ``del evaluate._raft_sweep_jit`` to force a re-trace).  The same
+    caveat applies to the bank with more force: banked executables
+    outlive the process, so evaluators whose closures differ must
+    differ in the memo key (the code/flag/aval fingerprints cover
+    everything else)."""
+    from raft_tpu.aot import bank
+
+    # the bank's cross-process key additionally carries the program
+    # identity the evaluator factory stamped (a content hash of the
+    # design + factory arguments — raft_tpu.aot.bank.content_fingerprint);
+    # an unstamped closure is memoized but never banked, because
+    # nothing else in the key distinguishes its baked-in constants
+    pk = bank.program_key(evaluate)
+    key = key + (("program", pk),)
     if getattr(evaluate, "__self__", None) is not None:
         # bound method: its attribute dict is the CLASS function's,
         # shared by every instance — memoizing there would hand
         # instance B a program compiled over instance A's state
-        return build()
+        return bank.BankedProgram(key[0], key, build,
+                                  bankable=pk is not None)
     try:
         per = evaluate.__dict__.setdefault("_raft_sweep_jit", {})
     except AttributeError:  # no attribute dict: no memoization
-        return build()
+        return bank.BankedProgram(key[0], key, build,
+                                  bankable=pk is not None)
     if key not in per:
-        # first build for this memo key: the next dispatch traces and
-        # compiles — worth a telemetry mark, because an unexpected
-        # growth of this counter IS the recompile storm the sentinel
-        # (raft_tpu.analysis.recompile) exists to catch
+        # first build for this memo key: the next dispatch loads from
+        # the bank or traces+compiles — worth a telemetry mark, because
+        # an unexpected growth of this counter IS the recompile storm
+        # the sentinel (raft_tpu.analysis.recompile) exists to catch
         metrics.counter("sweep_programs_built").inc()
         log_event("sweep_program_built", kind=key[0],
                   out_keys=list(key[1]))
-        per[key] = build()
+        per[key] = bank.BankedProgram(key[0], key, build,
+                                      bankable=pk is not None)
     return per[key]
 
 
@@ -140,7 +165,12 @@ def sweep_cases(evaluate, Hs, Tp, beta, mesh=None, out_keys=("PSD", "X0")):
 
     fn = _cached_jit(evaluate, ("cases", tuple(out_keys), _mesh_key(mesh),
                                 _flags_key()), build)
-    args = [jax.device_put(jnp.asarray(x), sharding) for x in (Hs, Tp, beta)]
+    # device_put from HOST numpy: the runtime scatters host buffers to
+    # the sharding directly, whereas device_put of an uncommitted jax
+    # array reshards through a tiny jitted _multi_slice program — an
+    # avoidable compile (and a spurious backend_compile event) on the
+    # very dispatch the AOT bank promises is compile-free
+    args = [jax.device_put(np.asarray(x), sharding) for x in (Hs, Tp, beta)]
     with span("sweep_dispatch", kind="cases", rows=len(args[0])):
         return fn(*args)
 
@@ -203,8 +233,10 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
     fn = _cached_jit(
         evaluate, ("cases_full", tuple(out_keys), tuple(sorted(cases)),
                    bool(shard_freq), _mesh_key(mesh), _flags_key()), build)
+    # host-numpy device_put: no resharding program, no compile event
+    # (see sweep_cases)
     args = jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(jnp.asarray(x), s), dict(cases), in_sh)
+        lambda x, s: jax.device_put(np.asarray(x), s), dict(cases), in_sh)
     with span("sweep_dispatch", kind="cases_full",
               rows=next(iter(lengths.values()))):
         return fn(args)

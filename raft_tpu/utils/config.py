@@ -178,6 +178,40 @@ _register(
          default_factory=lambda: os.path.join(
              os.path.expanduser("~"), ".cache", "raft_tpu", "jax_cache"),
          help="persistent XLA compilation-cache directory"),
+    Flag("CACHE_MIN_COMPILE_S", "float", 0.0,
+         help="only XLA compilations at least this long persist to the "
+              "disk cache.  0 (default) persists everything: on a CPU "
+              "build host most programs compile in under 10s and a "
+              "nonzero threshold silently disables cross-process cache "
+              "hits; the cost is cache-directory growth (bound it with "
+              "an external tmpwatch, or raise the threshold on hosts "
+              "where only the multi-minute accelerator programs matter)"),
+    # -- AOT program bank (see raft_tpu.aot and README "AOT program
+    #    bank & warmup")
+    Flag("AOT", "choice", "off", choices=("off", "load", "require"),
+         help="ahead-of-time program bank: 'load' consults the bank "
+              "before tracing and exports freshly-compiled sweep "
+              "programs for the next process; 'require' additionally "
+              "treats a bank miss per RAFT_TPU_AOT_MISS (serving mode: "
+              "cold start must be trace- and compile-free)"),
+    Flag("AOT_DIR", "str",
+         default_factory=lambda: os.path.join(
+             os.path.expanduser("~"), ".cache", "raft_tpu", "aot_bank"),
+         help="AOT program-bank directory (versioned layout inside)"),
+    Flag("AOT_MISS", "choice", "error", choices=("error", "compile"),
+         help="what RAFT_TPU_AOT=require does on a bank miss: 'error' "
+              "raises BankMissError (fail loudly before any XLA work); "
+              "'compile' logs the miss and falls back to trace+compile"),
+    Flag("COMPILE_BUDGET", "int", -1,
+         help="hard ceiling on XLA backend compilations per process "
+              "(-1 disables).  Enforced by the recompile sentinel "
+              "listener: steady state stays 0, and a cold start with a "
+              "warm AOT bank + XLA disk cache must also be 0"),
+    Flag("COMPILE_BUDGET_ACTION", "choice", "error",
+         choices=("error", "warn"),
+         help="exceeding RAFT_TPU_COMPILE_BUDGET raises "
+              "RecompilationError ('error') or only logs + counts "
+              "('warn')"),
     Flag("BEM_DIR", "str",
          default_factory=lambda: os.path.join(os.getcwd(), "_bem_cache"),
          help="panel-method BEM coefficient cache directory"),

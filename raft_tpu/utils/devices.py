@@ -39,7 +39,7 @@ def to_host(tree):
 
 
 def enable_compile_cache(cache_dir=None, platform=None,
-                         min_compile_secs=10.0):
+                         min_compile_secs=None):
     """Enable the persistent XLA compilation cache (idempotent).
 
     Promoted from the ad-hoc ``_enable_compile_cache`` in ``bench.py``
@@ -49,13 +49,27 @@ def enable_compile_cache(cache_dir=None, platform=None,
     not just the bench.  Repeated driver retries / sweep resumes then
     skip recompilation entirely.
 
+    This is also the single funnel every entry point passes through on
+    the way to a sweep, so the cold-start machinery is armed here: the
+    recompile sentinel/telemetry listener (``xla_compiles``) and the
+    AOT program-bank counters the sweep dispatcher and the bench
+    report from (:mod:`raft_tpu.aot.bank` — the bank itself is
+    consulted lazily per dispatch, gated by ``RAFT_TPU_AOT``).
+
     cache_dir : cache location; default ``RAFT_TPU_CACHE_DIR``, else
         ``~/.cache/raft_tpu/jax_cache``.
     platform : optional platform pin (e.g. ``"cpu"``) — the axon TPU
         plugin in this image overrides ``JAX_PLATFORMS`` at import
         time, so an explicit platform request must go through the
         config, not the env var.
-    min_compile_secs : only compilations at least this long persist.
+    min_compile_secs : only compilations at least this long persist;
+        default from ``RAFT_TPU_CACHE_MIN_COMPILE_S`` (0.0: persist
+        everything).  The old hard-coded 10.0 silently disabled the
+        disk cache for every sub-10s program — which on a CPU build
+        host is nearly all of them, so each fresh process re-compiled
+        from scratch.  The trade-off of 0 is cache-directory growth;
+        raise the flag on hosts where only multi-minute accelerator
+        compilations are worth persisting.
 
     Returns the cache directory in use (None when the cache could not
     be enabled — e.g. jax already finalised its config).
@@ -66,12 +80,20 @@ def enable_compile_cache(cache_dir=None, platform=None,
     # *counting*: arm the telemetry feed (xla_compiles counter) here so
     # drivers/sweeps/bench all get it without a separate call
     from raft_tpu.analysis.recompile import install as _install_sentinel
+    from raft_tpu.obs import metrics
 
     _install_sentinel()
+    # pre-register the bank counters so sweep manifests / metrics.json
+    # state "0 loads" explicitly instead of omitting the story
+    for name in ("aot_programs_loaded", "aot_programs_compiled",
+                 "aot_bank_misses"):
+        metrics.counter(name)
     if platform:
         jax.config.update("jax_platforms", platform)
     if cache_dir is None:
         cache_dir = config.get("CACHE_DIR")
+    if min_compile_secs is None:
+        min_compile_secs = config.get("CACHE_MIN_COMPILE_S")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",

@@ -37,14 +37,18 @@ def main():
 
     import jax
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
-    try:
-        jax.config.update("jax_compilation_cache_dir", os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "_jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
-    except Exception:
-        pass
+    # the shared funnel (raft_tpu.utils.devices.enable_compile_cache):
+    # repo-local XLA disk cache (threshold from RAFT_TPU_CACHE_MIN_
+    # COMPILE_S, default 0 so sub-10s CPU programs persist too), the
+    # recompile-sentinel telemetry, and the AOT program-bank counters —
+    # with RAFT_TPU_AOT=load a resumed/fresh run loads its sweep
+    # programs from the bank instead of re-tracing for half a minute
+    from raft_tpu.utils.devices import enable_compile_cache
+
+    enable_compile_cache(
+        cache_dir=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "_jax_cache"),
+        platform=args.platform or None)
     import jax.numpy as jnp
 
     import bench
@@ -95,6 +99,19 @@ def main():
             X0=x0, drag_resid=jnp.max(per_case["drag_resid"]),
             status=status,
         )
+
+    # AOT-bank identity for this wrapper closure: the inner evaluator's
+    # design-content stamp plus the case table it bakes in — without
+    # the stamp the sweep funnel memoizes but never banks the program
+    # (raft_tpu.aot.bank), and resumed/fresh runs would re-trace
+    from raft_tpu.aot import bank as aot_bank
+
+    evaluate_design._raft_program_key = (
+        "sweep10k_design_summary", aot_bank.program_key(evaluate),
+        aot_bank.content_fingerprint(bench.CASES),
+        # this wrapper's traced math lives OUTSIDE raft_tpu/ (the
+        # bank's code fingerprint), so its source content joins the key
+        aot_bank.file_fingerprint(os.path.abspath(__file__)))
 
     g4 = bench.sample_geometry(args.n, seed=11).astype(np.float32)
     if mesh is None:
@@ -172,6 +189,15 @@ def main():
         escalation_rungs=cnt.get("escalation_rungs", 0),
         escalations_resolved=cnt.get("escalations_resolved", 0),
         xla_compiles=cnt.get("xla_compiles", 0),
+        xla_cache_hits=cnt.get("xla_cache_hits", 0),
+        # cold-start provenance: which sweep programs came from the AOT
+        # bank vs a fresh trace+compile this run (the same counters land
+        # in <out_dir>/metrics.json and the manifest at sweep_done, so a
+        # resumed run's artifact states its cache story instead of
+        # implying a 33s trace that never happened)
+        programs_loaded=cnt.get("aot_programs_loaded", 0),
+        programs_compiled=cnt.get("aot_programs_compiled", 0),
+        aot_mode=config.get("AOT"),
         cases_per_design=len(bench.CASES),
         n_freq=int(model.nw),
         wall_s=round(wall, 2),

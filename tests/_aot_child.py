@@ -1,0 +1,111 @@
+"""Subprocess body for the AOT program-bank tests (tests/test_aot.py).
+
+Runs ONE sweep in a fresh JAX runtime and reports a JSON line on
+stdout.  Everything is driven by environment variables so the parent
+test composes scenarios without argument plumbing:
+
+    RAFT_TPU_AOT / RAFT_TPU_AOT_DIR / RAFT_TPU_CACHE_DIR /
+    RAFT_TPU_COMPILE_BUDGET      — the flags under test
+    AOT_CHILD_OUT                — where to savez the sweep outputs
+    AOT_CHILD_MODEL              — "spar": the bundled spar model via
+                                   make_case_evaluator (the acceptance
+                                   path); unset: a tiny deterministic
+                                   closure (fast mechanics tests)
+    AOT_CHILD_FAKE_CODE          — pretend the raft_tpu sources have a
+                                   different content hash (simulates a
+                                   code edit / jax upgrade: stored
+                                   entries must MISS cleanly)
+
+Not a pytest module (underscore name): executed via ``python -m`` from
+test subprocesses only.
+"""
+
+import json
+import os
+import sys
+import time
+
+t_proc = time.perf_counter()
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from raft_tpu.analysis import recompile  # noqa: E402
+from raft_tpu.aot import bank  # noqa: E402
+from raft_tpu.obs import metrics  # noqa: E402
+from raft_tpu.parallel.sweep import make_mesh, sweep_cases  # noqa: E402
+
+
+def tiny_evaluator():
+    def evaluate(h, t, b):
+        w = jnp.linspace(0.1, 2.0, 16)
+        psd = (h / t) ** 2 / ((w - 2 * np.pi / t) ** 2 + 0.01)
+        return {"PSD": psd, "X0": jnp.stack([h * jnp.cos(b),
+                                             h * jnp.sin(b)])}
+
+    evaluate._raft_program_key = ("aot_child_tiny", 1)
+    return evaluate, ("PSD", "X0")
+
+
+def spar_evaluator():
+    import raft_tpu
+    from raft_tpu import api
+
+    design = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "raft_tpu", "designs", "spar_demo.yaml")
+    model = raft_tpu.Model(design)
+    return api.make_case_evaluator(model), ("PSD", "X0", "status")
+
+
+def main():
+    if os.environ.get("AOT_CHILD_FAKE_CODE"):
+        bank._CODE_FP_CACHE.clear()
+        bank.code_fingerprint = lambda: os.environ["AOT_CHILD_FAKE_CODE"]
+
+    if os.environ.get("AOT_CHILD_MODEL") == "spar":
+        evaluate, out_keys = spar_evaluator()
+    else:
+        evaluate, out_keys = tiny_evaluator()
+    build_done_s = time.perf_counter() - t_proc
+
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(7)
+    Hs = rng.uniform(2.0, 8.0, 8)
+    Tp = rng.uniform(6.0, 14.0, 8)
+    beta = rng.uniform(-0.5, 0.5, 8)
+
+    with recompile.count_compilations() as log:
+        out = sweep_cases(evaluate, Hs, Tp, beta, mesh=mesh,
+                          out_keys=out_keys)
+        jax.block_until_ready(out)
+    cold_start_s = time.perf_counter() - t_proc
+
+    out_path = os.environ.get("AOT_CHILD_OUT")
+    if out_path:
+        np.savez(out_path, **{k: np.asarray(v) for k, v in out.items()})
+
+    c = metrics.snapshot()["counters"]
+    print(json.dumps({
+        "cold_start_s": round(cold_start_s, 2),
+        "build_s": round(build_done_s, 2),
+        "sweep_compile_events": log.count,
+        "sweep_real_compiles": log.real_count,
+        "process_real_compiles": recompile.PROCESS_LOG.real_count,
+        "loaded": c.get("aot_programs_loaded", 0),
+        "compiled": c.get("aot_programs_compiled", 0),
+        "misses": c.get("aot_bank_misses", 0),
+        "errors": c.get("aot_bank_errors", 0),
+    }))
+
+
+if __name__ == "__main__":
+    main()

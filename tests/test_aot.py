@@ -1,0 +1,353 @@
+"""Tier-1 tests for the AOT program bank (:mod:`raft_tpu.aot`).
+
+* mechanics, in-process: store/load round trip is bit-identical to the
+  freshly-traced program; key misses are CLEAN (flag flip, code/jax
+  fingerprint change, corrupted payload) — a stale or damaged entry can
+  re-lower or fail loudly, never execute;
+* the maintenance CLI (``list``/``verify``/``gc``) catches orphans and
+  corruption and reclaims dead entries;
+* the process-wide compile budget (``RAFT_TPU_COMPILE_BUDGET``)
+  raises/warns at the dispatch that compiled;
+* cross-process, fresh JAX runtime (the ISSUE acceptance): export in
+  one process, load in a subprocess — bit-identical outputs with ZERO
+  backend-compile events (sentinel-asserted), including the real spar
+  model warmed through ``python -m raft_tpu.aot warmup``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.analysis import recompile
+from raft_tpu.aot import bank
+from raft_tpu.aot.__main__ import main as aot_cli
+from raft_tpu.obs import metrics
+from raft_tpu.parallel.sweep import make_mesh, sweep_cases
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "_aot_child.py")
+SPAR = os.path.join(REPO, "raft_tpu", "designs", "spar_demo.yaml")
+
+
+def tiny_evaluator(stamp=("tiny", 1)):
+    """Deterministic stamped closure (fresh object per call, so each
+    test controls its own sweep memo)."""
+
+    def evaluate(h, t, b):
+        w = jnp.linspace(0.1, 2.0, 16)
+        psd = (h / t) ** 2 / ((w - 2 * np.pi / t) ** 2 + 0.01)
+        return {"PSD": psd, "X0": jnp.stack([h * jnp.cos(b),
+                                             h * jnp.sin(b)])}
+
+    if stamp is not None:
+        evaluate._raft_program_key = stamp
+    return evaluate
+
+
+def run_sweep(evaluate, seed=3):
+    rng = np.random.default_rng(seed)
+    out = sweep_cases(evaluate, rng.uniform(2, 8, 8),
+                      rng.uniform(6, 14, 8), rng.uniform(-0.5, 0.5, 8),
+                      mesh=make_mesh(8))
+    jax.block_until_ready(out)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def counters():
+    return metrics.snapshot()["counters"]
+
+
+@pytest.fixture
+def bank_dir(tmp_path, monkeypatch):
+    d = tmp_path / "aot_bank"
+    monkeypatch.setenv("RAFT_TPU_AOT_DIR", str(d))
+    return d
+
+
+def bank_files(d, suffix=".json"):
+    v = d / f"v{bank.BANK_FORMAT}"
+    return sorted(p for p in v.iterdir() if p.name.endswith(suffix)) \
+        if v.is_dir() else []
+
+
+# ------------------------------------------------------------- mechanics
+
+def test_roundtrip_bit_identical_vs_fresh_trace(bank_dir, monkeypatch):
+    """load-mode export, then a fresh require-mode closure loads the
+    banked executable — zero compile events, outputs exactly equal to
+    the freshly-traced program's."""
+    monkeypatch.setenv("RAFT_TPU_AOT", "load")
+    c0 = counters()
+    out_fresh = run_sweep(tiny_evaluator())   # traces, compiles, exports
+    c1 = counters()
+    assert c1.get("aot_programs_compiled", 0) - \
+        c0.get("aot_programs_compiled", 0) == 1
+    assert len(bank_files(bank_dir)) == 1
+
+    monkeypatch.setenv("RAFT_TPU_AOT", "require")
+    with recompile.assert_compile_budget(0, "bank-loaded sweep"):
+        out_loaded = run_sweep(tiny_evaluator())  # new closure, same stamp
+    c2 = counters()
+    assert c2.get("aot_programs_loaded", 0) - \
+        c1.get("aot_programs_loaded", 0) == 1
+    for k in out_fresh:
+        np.testing.assert_array_equal(out_fresh[k], out_loaded[k])
+
+
+def test_flag_flip_is_a_miss(bank_dir, monkeypatch):
+    """A trace-time flag flip changes the key: require mode fails
+    loudly instead of serving the old-flag program."""
+    monkeypatch.setenv("RAFT_TPU_AOT", "load")
+    run_sweep(tiny_evaluator())
+    monkeypatch.setenv("RAFT_TPU_AOT", "require")
+    monkeypatch.setenv("RAFT_TPU_SOLVER", "lapack")
+    with pytest.raises(bank.BankMissError, match="warmup"):
+        run_sweep(tiny_evaluator())
+
+
+def test_require_miss_can_fall_back_flag_controlled(bank_dir, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_AOT", "require")
+    monkeypatch.setenv("RAFT_TPU_AOT_MISS", "compile")
+    c0 = counters()
+    out = run_sweep(tiny_evaluator())
+    assert np.isfinite(out["PSD"]).all()
+    c1 = counters()
+    assert c1.get("aot_bank_misses", 0) - c0.get("aot_bank_misses", 0) == 1
+    assert c1.get("aot_programs_compiled", 0) - \
+        c0.get("aot_programs_compiled", 0) == 1
+
+
+def test_stale_code_fingerprint_misses_cleanly(bank_dir, monkeypatch):
+    """A source edit (simulated: different code fingerprint) never
+    loads the old entry — require refuses, load re-lowers next to it."""
+    monkeypatch.setenv("RAFT_TPU_AOT", "load")
+    run_sweep(tiny_evaluator())
+    assert len(bank_files(bank_dir)) == 1
+
+    monkeypatch.setattr(bank, "code_fingerprint", lambda: "deadbeef" * 2)
+    monkeypatch.setenv("RAFT_TPU_AOT", "require")
+    with pytest.raises(bank.BankMissError):
+        run_sweep(tiny_evaluator())
+    monkeypatch.setenv("RAFT_TPU_AOT", "load")
+    run_sweep(tiny_evaluator())              # clean re-lower, new entry
+    assert len(bank_files(bank_dir)) == 2
+
+
+def test_corrupt_payload_is_miss_not_crash(bank_dir, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_AOT", "load")
+    run_sweep(tiny_evaluator())
+    [bin_path] = bank_files(bank_dir, ".bin")
+    bin_path.write_bytes(bin_path.read_bytes()[:64])   # truncate
+
+    monkeypatch.setenv("RAFT_TPU_AOT", "require")
+    c0 = counters()
+    with pytest.raises(bank.BankMissError):
+        run_sweep(tiny_evaluator())
+    assert counters().get("aot_bank_errors", 0) - \
+        c0.get("aot_bank_errors", 0) == 1
+
+    monkeypatch.setenv("RAFT_TPU_AOT", "load")
+    out = run_sweep(tiny_evaluator())        # re-compiles, heals the entry
+    assert np.isfinite(out["PSD"]).all()
+    monkeypatch.setenv("RAFT_TPU_AOT", "require")
+    with recompile.assert_compile_budget(0, "healed entry"):
+        run_sweep(tiny_evaluator())
+
+
+def test_unstamped_closure_is_never_banked(bank_dir, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_AOT", "load")
+    out = run_sweep(tiny_evaluator(stamp=None))
+    assert np.isfinite(out["PSD"]).all()
+    assert bank_files(bank_dir) == []        # nothing exported
+
+
+def test_off_mode_touches_nothing(bank_dir, monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_AOT", raising=False)
+    run_sweep(tiny_evaluator())
+    assert not (bank_dir / f"v{bank.BANK_FORMAT}").exists()
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_cli_list_verify_gc(bank_dir, monkeypatch, capsys):
+    monkeypatch.setenv("RAFT_TPU_AOT", "load")
+    run_sweep(tiny_evaluator())
+    [meta_path] = bank_files(bank_dir)
+    key = meta_path.name[:-5]
+
+    assert aot_cli(["verify"]) == 0
+    assert aot_cli(["list"]) == 0
+    assert key in capsys.readouterr().out
+
+    stray = meta_path.parent / (meta_path.name + ".tmp")
+    stray.write_bytes(b"interrupted write")
+    assert aot_cli(["verify"]) == 0          # note, not a CI failure
+    assert "interrupted" in capsys.readouterr().out
+
+    orphan = meta_path.parent / ("ff" * 12 + ".bin")
+    orphan.write_bytes(b"not an executable")
+    assert aot_cli(["verify"]) == 1
+    assert "orphan" in capsys.readouterr().err
+
+    meta_path.write_text("{not json")
+    assert aot_cli(["verify"]) == 1
+
+    assert aot_cli(["gc"]) == 0     # corrupt + orphan + .tmp reclaimed
+    assert not stray.exists()
+    assert aot_cli(["verify"]) == 0
+    assert aot_cli(["gc", "--all"]) == 0
+    assert bank_files(bank_dir) == [] and bank_files(bank_dir, ".bin") == []
+
+
+def test_content_fingerprint_deterministic_and_content_blind_fallback():
+    """Equal content hashes equal (incl. object arrays, which must
+    recurse instead of hashing pointer bytes); distinct content hashes
+    differently; non-coercible objects degrade to type identity."""
+    a = np.array([{"Hs": 6.0}, [1, 2]], dtype=object)
+    b = np.array([{"Hs": 6.0}, [1, 2]], dtype=object)
+    assert bank.content_fingerprint(a) == bank.content_fingerprint(b)
+    c = np.array([{"Hs": 7.0}, [1, 2]], dtype=object)
+    assert bank.content_fingerprint(a) != bank.content_fingerprint(c)
+
+    class Opaque:
+        pass
+
+    assert bank.content_fingerprint(Opaque()) == \
+        bank.content_fingerprint(Opaque())
+
+
+def test_warmup_rejects_unknown_kind():
+    from raft_tpu.aot import warmup
+
+    with pytest.raises(ValueError, match="unknown warmup kind"):
+        warmup.warmup_model(kinds=("case",))   # typo: singular
+
+
+# -------------------------------------------------------- compile budget
+
+def test_compile_budget_enforced_and_warn(monkeypatch):
+    recompile.install()
+    # the persistent cache would classify a repeat program as a disk
+    # hit (budget-exempt); force real compiles for determinism
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        monkeypatch.setenv("RAFT_TPU_COMPILE_BUDGET",
+                           str(recompile.PROCESS_LOG.real_count))
+        with pytest.raises(recompile.RecompilationError,
+                           match="RAFT_TPU_COMPILE_BUDGET"):
+            jax.jit(lambda x: x * 1.618 + 0.577)(
+                jnp.ones(5)).block_until_ready()
+
+        monkeypatch.setenv("RAFT_TPU_COMPILE_BUDGET_ACTION", "warn")
+        monkeypatch.setenv("RAFT_TPU_COMPILE_BUDGET",
+                           str(recompile.PROCESS_LOG.real_count))
+        c0 = counters().get("compile_budget_exceeded", 0)
+        jax.jit(lambda x: x * 2.718 - 1.414)(
+            jnp.ones(5)).block_until_ready()   # logs, does not raise
+        assert counters()["compile_budget_exceeded"] > c0
+    finally:
+        jax.config.update("jax_enable_compilation_cache", old)
+
+
+# -------------------------------------------- cross-process (fresh runtime)
+
+def _run_child(env_overrides, out_path=None):
+    env = {k: v for k, v in os.environ.items()
+           if not (k.startswith("RAFT_TPU_") or k.startswith("AOT_CHILD"))}
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.update(env_overrides)
+    if out_path:
+        env["AOT_CHILD_OUT"] = str(out_path)
+    p = subprocess.run([sys.executable, CHILD], env=env, timeout=600,
+                       capture_output=True, text=True)
+    assert p.returncode == 0, f"child failed:\n{p.stderr[-2000:]}"
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def test_subprocess_export_then_fresh_runtime_loads(tmp_path):
+    """The acceptance mechanics end to end: export in process A, load
+    in process B with a FRESH JAX runtime — xla_compiles == 0
+    (sentinel-asserted in the child) and bit-identical outputs vs the
+    freshly-traced program."""
+    base = {"RAFT_TPU_AOT_DIR": str(tmp_path / "bank"),
+            "RAFT_TPU_CACHE_DIR": str(tmp_path / "xla")}
+    r1 = _run_child({**base, "RAFT_TPU_AOT": "load"},
+                    tmp_path / "a.npz")
+    assert r1["compiled"] == 1 and r1["loaded"] == 0
+
+    r2 = _run_child({**base, "RAFT_TPU_AOT": "require",
+                     "RAFT_TPU_COMPILE_BUDGET": "0"},
+                    tmp_path / "b.npz")
+    assert r2["loaded"] == 1 and r2["compiled"] == 0
+    assert r2["sweep_compile_events"] == 0
+    assert r2["process_real_compiles"] == 0
+
+    a = np.load(tmp_path / "a.npz")
+    b = np.load(tmp_path / "b.npz")
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_subprocess_stale_code_relowers_not_crashes(tmp_path):
+    """Process B pretends the sources changed (new code fingerprint):
+    the stored entry must MISS and re-lower cleanly — same results,
+    one fresh compile, two entries on disk."""
+    base = {"RAFT_TPU_AOT_DIR": str(tmp_path / "bank"),
+            "RAFT_TPU_CACHE_DIR": str(tmp_path / "xla")}
+    r1 = _run_child({**base, "RAFT_TPU_AOT": "load"}, tmp_path / "a.npz")
+    assert r1["compiled"] == 1
+
+    r2 = _run_child({**base, "RAFT_TPU_AOT": "load",
+                     "AOT_CHILD_FAKE_CODE": "0123456789abcdef"},
+                    tmp_path / "b.npz")
+    assert r2["compiled"] == 1 and r2["loaded"] == 0 and r2["misses"] == 1
+
+    a, b = np.load(tmp_path / "a.npz"), np.load(tmp_path / "b.npz")
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k])
+    v = tmp_path / "bank" / f"v{bank.BANK_FORMAT}"
+    assert len([p for p in v.iterdir() if p.name.endswith(".json")]) == 2
+
+
+def test_spar_warmup_then_fresh_process_is_compile_free(tmp_path):
+    """The ISSUE acceptance on the real model: `python -m raft_tpu.aot
+    warmup` exports the spar case-evaluator sweep program; a fresh
+    process then answers the same sweep under RAFT_TPU_AOT=require +
+    RAFT_TPU_COMPILE_BUDGET=0 — 1 bank load, 0 compiles, and a cold
+    start far below the trace+compile cost it replaced (~25s on this
+    host)."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("RAFT_TPU_")}
+    env.update(XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               RAFT_TPU_AOT_DIR=str(tmp_path / "bank"),
+               RAFT_TPU_CACHE_DIR=str(tmp_path / "xla"))
+    # --x64 matches the child runtime (the parity suite runs x64; x64
+    # is part of the bank's environment fingerprint)
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.aot", "warmup",
+         "--design", SPAR, "--kinds", "cases", "--n", "8", "--x64"],
+        env=env, timeout=600, capture_output=True, text=True, cwd=REPO)
+    assert p.returncode == 0, f"warmup failed:\n{p.stderr[-2000:]}"
+    assert "compiled 1 program(s)" in p.stdout
+
+    r = _run_child({"RAFT_TPU_AOT_DIR": str(tmp_path / "bank"),
+                    "RAFT_TPU_CACHE_DIR": str(tmp_path / "xla"),
+                    "RAFT_TPU_AOT": "require",
+                    "RAFT_TPU_COMPILE_BUDGET": "0",
+                    "AOT_CHILD_MODEL": "spar"},
+                   tmp_path / "spar.npz")
+    assert r["loaded"] == 1 and r["compiled"] == 0
+    assert r["sweep_compile_events"] == 0
+    assert r["process_real_compiles"] == 0
+    # trace+compile alone costs ~25s here; a bank hit must stay well
+    # under that even with wall-clock noise
+    assert r["cold_start_s"] < 20.0
+    out = np.load(tmp_path / "spar.npz")
+    assert np.isfinite(out["PSD"]).all()
