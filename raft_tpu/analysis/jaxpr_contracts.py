@@ -3,7 +3,10 @@
 The public entry points — :func:`raft_tpu.models.dynamics.
 solve_dynamics_fowt`, :func:`~raft_tpu.models.dynamics.system_response`,
 :func:`raft_tpu.physics.morison.drag_lin_iter`, the design-sweep
-evaluator (:func:`raft_tpu.api.make_design_evaluator`) and the
+evaluator (:func:`raft_tpu.api.make_design_evaluator`), the
+shape-bucketed heterogeneous-design evaluator
+(:mod:`raft_tpu.structure.bucketing`, entry ``bucket_evaluator`` —
+its per-bucket primitive budget keeps padding waste honest) and the
 solver-health status fold (:mod:`raft_tpu.utils.health`, entry
 ``health_status``) — are traced (``jax.make_jaxpr``, no
 compile/execute) on the bundled spar design and checked against
@@ -101,6 +104,17 @@ CONTRACTS = {
     # solve_dynamics_fowt entry above.
     "design_evaluator": Contract(
         "design_evaluator", dtype_clean="",
+        fixed_point_modes=("while", "scan")),
+    # the shape-bucketed heterogeneous-design evaluator
+    # (raft_tpu.structure.bucketing) traced on the bundled spar packed
+    # into ITS bucket: the per-bucket primitive budget keeps padding
+    # waste honest — a bucket program is supposed to cost one padded
+    # design's worth of primitives, so growth here means the padded
+    # chain picked up per-design work (or mask plumbing regressed into
+    # re-gathers); dtype contract off for the same statics-precision
+    # reason as design_evaluator
+    "bucket_evaluator": Contract(
+        "bucket_evaluator", dtype_clean="",
         fixed_point_modes=("while", "scan")),
     # the solver-health status-assembly path (raft_tpu.utils.health +
     # the evaluators' _case_status fold): pure elementwise bit
@@ -273,6 +287,18 @@ class EntryPointTracer:
                     {"Hs": p[0], "Tp": p[1], "beta": p[2],
                      "Cd_scale": p[3]}))(
                     jnp.asarray([6.0, 12.0, 0.0, 1.0], dtype=rdt))
+            if entry == "bucket_evaluator":
+                from raft_tpu.structure import bucketing
+
+                sig = bucketing.bucket_signature(model)
+                packed = bucketing.pack_design(model, sig)
+                ev = bucketing.make_bucket_evaluator(sig)
+                case = dict(
+                    design={k2: jnp.asarray(v) for k2, v in packed.items()},
+                    Hs=jnp.asarray(6.0, dtype=rdt),
+                    Tp=jnp.asarray(12.0, dtype=rdt),
+                    beta=jnp.asarray(0.0, dtype=rdt))
+                return jax.make_jaxpr(ev)(case)
             if entry == "health_status":
                 # the evaluators' status fold at representative shapes:
                 # statics word | dynamics word | output-finiteness and

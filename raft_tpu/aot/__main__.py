@@ -141,7 +141,9 @@ def main(argv=None):
                         "to the dp mesh-axis size)")
     p.add_argument("--kinds", default=",".join(
         ("cases", "full", "design")),
-        help="comma list of sweep kinds: cases,full,design")
+        help="comma list of sweep kinds: cases,full,design,bucketed "
+             "(bucketed warms the shape-bucketed heterogeneous-design "
+             "programs over the bundled design trio)")
     p.add_argument("--out-keys", default="PSD,X0,status",
                    help="out_keys of the warmed programs (include "
                         "'status' to warm the health fold)")

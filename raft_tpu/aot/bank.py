@@ -356,16 +356,28 @@ def _compile_fresh(lowered):
     CPU: a later deserialize fails with ``INTERNAL: Symbols not
     found``) — storing one would mint a poison bank entry.  One full
     compile is the honest price of a durable artifact; the entry then
-    supersedes the disk cache for every future process."""
+    supersedes the disk cache for every future process.
+
+    Flipping ``jax_enable_compilation_cache`` alone is NOT enough:
+    ``compilation_cache.is_cache_used`` latches its decision in module
+    globals at the first compile of the process, so once anything
+    compiled with the cache on, the flag flip is ignored and the cache
+    still answers (the self-check then rejects every export — a bank
+    that can never be re-warmed while the XLA cache holds the program).
+    ``reset_cache()`` clears the latch; a second reset afterwards lets
+    the next ordinary compile re-latch with the cache enabled."""
     import jax
+    from jax._src import compilation_cache
 
     if not jax.config.jax_enable_compilation_cache:
         return lowered.compile()
     jax.config.update("jax_enable_compilation_cache", False)
+    compilation_cache.reset_cache()
     try:
         return lowered.compile()
     finally:
         jax.config.update("jax_enable_compilation_cache", True)
+        compilation_cache.reset_cache()
 
 
 def store(kind, memo_key, args, lowered, compiled, compile_s):

@@ -38,6 +38,17 @@ import numpy as np
 
 DEFAULT_OUT_KEYS = ("PSD", "X0", "status")
 DEFAULT_KINDS = ("cases", "full", "design")
+#: ``bucketed`` warms the shape-bucketed heterogeneous-design programs
+#: (raft_tpu.structure.bucketing) over the BUNDLED design trio — one
+#: program per bucket signature, shared by every design in the bucket —
+#: so a fresh process answers a mixed-topology sweep with zero compiles
+ALL_KINDS = DEFAULT_KINDS + ("bucketed",)
+
+_DESIGNS_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "designs")
+BUCKET_WARMUP_DESIGNS = tuple(
+    os.path.join(_DESIGNS_DIR, f) for f in
+    ("spar_demo.yaml", "semi_demo.yaml", "mhk_demo.yaml"))
 
 
 @contextlib.contextmanager
@@ -79,28 +90,31 @@ def warmup_model(design=None, sizes=(8,), kinds=DEFAULT_KINDS,
     from raft_tpu.utils.devices import enable_compile_cache
     from raft_tpu.utils.structlog import log_event
 
-    unknown = set(kinds) - set(DEFAULT_KINDS)
+    unknown = set(kinds) - set(ALL_KINDS)
     if unknown:
         # a typo'd kind must not report a successful no-op warmup — the
         # serving replica would discover the cold bank as BankMissError
         raise ValueError(f"unknown warmup kind(s) {sorted(unknown)}; "
-                         f"choose from {list(DEFAULT_KINDS)}")
+                         f"choose from {list(ALL_KINDS)}")
     enable_compile_cache()
-    if design is None:
-        design = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "designs", "spar_demo.yaml")
-    model = raft_tpu.Model(design)
     if mesh is None:
         mesh = make_mesh()
     dp = mesh.shape.get("dp", mesh.devices.size)
 
+    # the single-design model only feeds the non-bucketed kinds; a
+    # bucketed-only warmup must not pay its YAML load + host build
     evaluators = {}
-    if "cases" in kinds:
-        evaluators["cases"] = api.make_case_evaluator(model)
-    if "full" in kinds:
-        evaluators["full"] = api.make_full_evaluator(model)
-    if "design" in kinds:
-        evaluators["design"] = api.make_design_evaluator(model)
+    if set(kinds) - {"bucketed"}:
+        if design is None:
+            design = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "designs", "spar_demo.yaml")
+        model = raft_tpu.Model(design)
+        if "cases" in kinds:
+            evaluators["cases"] = api.make_case_evaluator(model)
+        if "full" in kinds:
+            evaluators["full"] = api.make_full_evaluator(model)
+        if "design" in kinds:
+            evaluators["design"] = api.make_design_evaluator(model)
 
     reports = []
     with _force_load_mode():
@@ -140,5 +154,50 @@ def warmup_model(design=None, sizes=(8,), kinds=DEFAULT_KINDS,
                 log_event("aot_warmup", kind=kind, n=rows,
                           loaded=rep["loaded"], compiled=rep["compiled"],
                           wall_s=rep["wall_s"])
+                reports.append(rep)
+
+        if "bucketed" in kinds:
+            # heterogeneous shape buckets over the bundled design trio.
+            # ``--n`` stays PER-PROGRAM like every other kind: the bank
+            # keys on input avals, so each bucket must be warmed at the
+            # per-bucket group size a production mixed sweep will
+            # dispatch — n rows of EVERY bundled bucket signature, not
+            # n rows split ~n/n_buckets ways across them
+            from raft_tpu.parallel.sweep import sweep_heterogeneous
+            from raft_tpu.structure import bucketing
+
+            bmodels = [raft_tpu.Model(p) for p in BUCKET_WARMUP_DESIGNS]
+            by_sig = {}
+            for bm in bmodels:
+                by_sig.setdefault(bucketing.bucket_signature(bm),
+                                  []).append(bm)
+            for n in sizes:
+                rows = _round_up(int(n), dp)
+                models_row = []
+                for group in by_sig.values():
+                    models_row += [group[i % len(group)]
+                                   for i in range(rows)]
+                total = len(models_row)
+                rng = np.random.default_rng(0)
+                c0 = {k: metrics.counter(k).value for k in
+                      ("aot_programs_loaded", "aot_programs_compiled")}
+                t0 = time.perf_counter()
+                out = sweep_heterogeneous(
+                    models_row, rng.uniform(2.0, 8.0, total),
+                    rng.uniform(6.0, 14.0, total),
+                    rng.uniform(-0.5, 0.5, total), mesh=mesh,
+                    out_keys=out_keys)
+                jax.block_until_ready(out)
+                wall = time.perf_counter() - t0
+                rep = dict(
+                    kind="bucketed", rows=rows, n_buckets=len(by_sig),
+                    wall_s=round(wall, 2),
+                    loaded=metrics.counter("aot_programs_loaded").value
+                    - c0["aot_programs_loaded"],
+                    compiled=metrics.counter("aot_programs_compiled").value
+                    - c0["aot_programs_compiled"])
+                log_event("aot_warmup", kind="bucketed", n=rows,
+                          n_buckets=len(by_sig), loaded=rep["loaded"],
+                          compiled=rep["compiled"], wall_s=rep["wall_s"])
                 reports.append(rep)
     return reports

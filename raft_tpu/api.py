@@ -83,6 +83,13 @@ def make_design_evaluator(model):
     the build-time tensors inside the trace, so the whole map is
     jit/vmap-able over designs AND differentiable (e.g. optimize
     mooring length against a response metric with ``jax.grad``).
+
+    For a design axis over *heterogeneous member layouts* (mixed
+    spar/semi/MHK topologies in one DoE) use the shape-bucketed path
+    instead: :func:`make_bucket_evaluator` /
+    :func:`raft_tpu.parallel.sweep.sweep_heterogeneous` make the
+    design itself a traced, validity-masked input padded to a shape
+    bucket, so one compiled program serves every layout in the bucket.
     """
     import dataclasses
 
@@ -166,6 +173,30 @@ def make_design_evaluator(model):
         )
 
     return _stamp_program_key(evaluate, "design_evaluator", model)
+
+
+def make_bucket_evaluator(sig):
+    """Traced case evaluator over PACKED HETEROGENEOUS DESIGNS — the
+    shape-bucketed design axis (re-exported from
+    :mod:`raft_tpu.structure.bucketing`; see that module for the
+    padding/masking contract).
+
+    ``sig`` is a bucket signature from
+    :func:`raft_tpu.structure.bucketing.bucket_signature`; the returned
+    ``evaluate(case)`` takes ``case["design"]`` (a
+    :func:`~raft_tpu.structure.bucketing.pack_design` pytree) plus
+    scalar ``Hs``/``Tp``/``beta`` and vmaps over the whole case dict,
+    so ONE compiled program serves every member layout that packs into
+    the bucket.  Most callers want the auto-binning dispatcher
+    :func:`raft_tpu.parallel.sweep.sweep_heterogeneous` instead.
+
+    Returns the PROCESS-CACHED evaluator for the signature (bucket
+    evaluators close over nothing but ``sig``): the sweep memo lives on
+    the evaluator's attribute dict, so handing every caller the same
+    object is what keeps repeat sweeps compile-free."""
+    from raft_tpu.structure.bucketing import get_bucket_evaluator
+
+    return get_bucket_evaluator(sig)
 
 
 def case_to_traced(case, nWaves=1):

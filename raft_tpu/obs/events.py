@@ -47,6 +47,15 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         ("error",),
         "jax profiler capture could not start/stop (logged, not fatal)"),
     # -------------------------------------------------------- sweep runtime
+    "dp_autopad": (
+        ("rows", "pad", "dp"),
+        "a ragged batch was padded to dp-divisibility with masked "
+        "repeat rows (dropped again on gather) — warning-level: the "
+        "caller is paying for rows it did not ask for"),
+    "bucket_sweep": (
+        ("rows", "n_buckets", "n_designs", "padding_waste_frac"),
+        "heterogeneous sweep dispatched: designs auto-binned into "
+        "shape buckets, one compiled program per bucket"),
     "sweep_start": (
         ("out_dir", "n_cases", "n_shards", "shard_size", "out_keys",
          "mesh_shape"),
@@ -134,8 +143,9 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         "bank garbage collection removed stale/orphaned entries "
         "(python -m raft_tpu.aot gc)"),
     "aot_warmup": (
-        ("kind", "n", "loaded", "compiled", "wall_s"),
-        "one warmup sweep dispatched (python -m raft_tpu.aot warmup)"),
+        ("kind", "n", "loaded", "compiled", "wall_s", "n_buckets?"),
+        "one warmup sweep dispatched (python -m raft_tpu.aot warmup); "
+        "bucketed kind warms n rows per bucket signature"),
     "compile_budget_exceeded": (
         ("count", "budget", "action"),
         "a backend compilation exceeded RAFT_TPU_COMPILE_BUDGET; "

@@ -127,29 +127,52 @@ def make_mesh(n_devices=None, axis_names=("dp",)):
     return Mesh(devices.reshape(shape), axis_names)
 
 
-def _check_dp_divisible(n, mesh):
-    """Reject batch sizes GSPMD cannot lay out, with a readable error
-    (the raw failure is a cryptic sharding/padding XlaRuntimeError)."""
+def _autopad_rows(n, mesh):
+    """Rows to append so GSPMD can lay out the batch over the dp axis.
+
+    Ragged batches used to raise here; now the sweep pads the tail by
+    repeating the last row (masked rows — they are dropped again when
+    the results are gathered) and keeps a ``dp_autopad`` warning event
+    so silently-padded dispatches stay visible in the event stream.
+    Note the drop itself is a device-side slice: the first ragged
+    dispatch of a given shape compiles one small one-off slice program
+    (a backend_compile event the recompile sentinel sees) — divisible
+    batches keep the strictly compile-free dispatch."""
     dp = mesh.shape.get("dp", 1)
-    if n % dp:
+    if n == 0:
         raise ValueError(
-            f"batch size {n} is not divisible by the dp mesh-axis size "
-            f"{dp} (mesh {dict(mesh.shape)}); pad the batch or use the "
-            "checkpointed drivers, which pad shard tails automatically")
+            f"empty batch cannot be laid out over the dp mesh axis "
+            f"(mesh {dict(mesh.shape)})")
+    pad = (-n) % dp
+    if pad:
+        metrics.counter("dp_autopad_rows").inc(pad)
+        log_event("dp_autopad", rows=n, pad=pad, dp=dp)
+    return pad
+
+
+def _pad_tail(a, pad):
+    """Repeat the last row ``pad`` times (host numpy, no device work)."""
+    a = np.asarray(a)
+    if not pad:
+        return a
+    return np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
 
 
 def sweep_cases(evaluate, Hs, Tp, beta, mesh=None, out_keys=("PSD", "X0")):
     """Evaluate a batch of sea states, sharded over the mesh's dp axis.
 
     evaluate : scalar-case function from :func:`raft_tpu.api.make_case_evaluator`
-    Hs/Tp/beta : (N,) arrays (N divisible by the dp axis size)
+    Hs/Tp/beta : (N,) arrays; a batch not divisible by the dp axis size
+        is auto-padded with masked repeat rows (dropped on gather,
+        ``dp_autopad`` warning event — see :func:`_autopad_rows`)
     """
     from raft_tpu.utils.devices import enable_compile_cache
 
     enable_compile_cache()
     if mesh is None:
         mesh = make_mesh()
-    _check_dp_divisible(len(np.asarray(Hs)), mesh)
+    n = len(np.asarray(Hs))
+    pad = _autopad_rows(n, mesh)
     sharding = NamedSharding(mesh, P("dp"))
 
     def build():
@@ -170,9 +193,13 @@ def sweep_cases(evaluate, Hs, Tp, beta, mesh=None, out_keys=("PSD", "X0")):
     # array reshards through a tiny jitted _multi_slice program — an
     # avoidable compile (and a spurious backend_compile event) on the
     # very dispatch the AOT bank promises is compile-free
-    args = [jax.device_put(np.asarray(x), sharding) for x in (Hs, Tp, beta)]
+    args = [jax.device_put(_pad_tail(x, pad), sharding)
+            for x in (Hs, Tp, beta)]
     with span("sweep_dispatch", kind="cases", rows=len(args[0])):
-        return fn(*args)
+        out = fn(*args)
+    if pad:  # drop the masked tail rows on gather
+        out = {k: v[:n] for k, v in out.items()}
+    return out
 
 
 def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
@@ -183,7 +210,8 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
         (or the farm/flexible variants)
     cases : dict of (N,) arrays — any subset of the evaluator's case
         keys (wind_speed, TI, Hs, Tp, beta_deg, geometry scales, ...);
-        N divisible by the dp axis size.
+        a ragged N auto-pads to dp-divisibility with masked repeat
+        rows (dropped on gather, ``dp_autopad`` warning event).
     shard_freq : also partition the FREQUENCY axis of the outputs over
         the mesh's "sp" axis (requires a 2D ("dp","sp") mesh).  The
         frequency axis is the workload's sequence axis (SURVEY §5.7);
@@ -210,7 +238,8 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
         raise ValueError(
             f"ragged case dict: all case arrays must have equal length, "
             f"got {lengths}")
-    _check_dp_divisible(next(iter(lengths.values())), mesh)
+    n = next(iter(lengths.values()))
+    pad = _autopad_rows(n, mesh)
     in_sh = jax.tree_util.tree_map(
         lambda _: NamedSharding(mesh, P("dp")), cases)
 
@@ -236,10 +265,152 @@ def sweep_cases_full(evaluate, cases, mesh=None, out_keys=("PSD", "X0"),
     # host-numpy device_put: no resharding program, no compile event
     # (see sweep_cases)
     args = jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(np.asarray(x), s), dict(cases), in_sh)
-    with span("sweep_dispatch", kind="cases_full",
-              rows=next(iter(lengths.values()))):
-        return fn(args)
+        lambda x, s: jax.device_put(_pad_tail(x, pad), s), dict(cases), in_sh)
+    with span("sweep_dispatch", kind="cases_full", rows=n):
+        out = fn(args)
+    if pad:  # drop the masked tail rows on gather
+        out = {k: v[:n] for k, v in out.items()}
+    return out
+
+
+def sweep_heterogeneous(models, Hs, Tp, beta, mesh=None,
+                        out_keys=("PSD", "X0", "status")):
+    """Sweep a batch of sea states over ARBITRARY MIXED designs with a
+    compile-bounded program count (SURVEY §7.3 hard part 2).
+
+    models : sequence of :class:`raft_tpu.Model`, one per case row
+        (repeat an object to evaluate it under several sea states; the
+        packed design pytree is built once per distinct model).
+    Hs/Tp/beta : (N,) sea-state arrays aligned with ``models``.
+
+    Designs are auto-binned by their bucket signature
+    (:func:`raft_tpu.structure.bucketing.bucket_signature`): every
+    group dispatches through ONE compiled program — the bucket
+    evaluator vmapped over (sea state x packed design) — so a sweep
+    over B distinct member layouts costs at most ``n_buckets``
+    backend compilations (``n_buckets <= B``, typically far fewer),
+    and each bucket program is AOT-bankable (its identity is the
+    signature, not any design).  Groups are padded to dp-divisibility
+    with masked repeat rows (dropped on gather) and results are
+    reassembled in input order.  Groups larger than
+    ``RAFT_TPU_BUCKET_ROWS`` (default 512; 0 = unlimited) dispatch in
+    fixed-size chunks of that many rows, capping the materialized
+    packed-design batch (each row carries its design's padded pytree,
+    Imat included) while every chunk reuses one compiled program.
+
+    Returns a dict of HOST numpy arrays of length N (reassembly is a
+    host-side scatter across buckets).
+    """
+    from raft_tpu.structure import bucketing
+    from raft_tpu.utils.devices import enable_compile_cache
+
+    enable_compile_cache()
+    if mesh is None:
+        mesh = make_mesh()
+    Hs = np.asarray(Hs, dtype=float)
+    Tp = np.asarray(Tp, dtype=float)
+    beta = np.asarray(beta, dtype=float)
+    n = len(Hs)
+    if n == 0:
+        raise ValueError("empty batch: no case rows to sweep")
+    if not (len(Tp) == len(beta) == n):
+        raise ValueError("Hs/Tp/beta must have equal length")
+    if len(models) != n:
+        raise ValueError(
+            f"need one model per case row: {len(models)} models for "
+            f"{n} rows (repeat model objects to reuse a design)")
+
+    # pack each DISTINCT model once; bin rows by bucket signature
+    packed_by_model = {}
+    row_sigs = []
+    for m in models:
+        ent = packed_by_model.get(id(m))
+        if ent is None:
+            sig = bucketing.bucket_signature(m)
+            ent = packed_by_model[id(m)] = (sig, bucketing.pack_design(m, sig))
+        row_sigs.append(ent[0])
+    w_grids = {tuple(bucketing.signature_meta(s)["w"])
+               for s in set(row_sigs)}
+    if len(w_grids) > 1:
+        raise ValueError(
+            "mixed frequency grids in one heterogeneous sweep: outputs "
+            "cannot be stacked; group the sweep by settings.min/max_freq")
+    groups = {}
+    for i, s in enumerate(row_sigs):
+        groups.setdefault(s, []).append(i)
+
+    # the packed design batch is materialized per ROW (np.stack below
+    # duplicates a repeated model's Imat for every row that uses it),
+    # so groups larger than RAFT_TPU_BUCKET_ROWS dispatch in fixed-size
+    # chunks of exactly that many rows — peak host/device memory stays
+    # chunk x design, and the last chunk pads up to the SAME row count
+    # (masked repeat rows) so every chunk reuses ONE compiled program
+    from raft_tpu.utils import config
+
+    dp = mesh.shape.get("dp", 1)
+    cap = int(config.get("BUCKET_ROWS"))
+    if cap:
+        cap = -(-cap // dp) * dp
+
+    sharding = NamedSharding(mesh, P("dp"))
+    out = {}
+    for sig, idxs in groups.items():
+        ev = bucketing.get_bucket_evaluator(sig)
+        leaf_names = packed_by_model[id(models[idxs[0]])][1].keys()
+        if cap and len(idxs) > cap:
+            chunks = [idxs[i:i + cap] for i in range(0, len(idxs), cap)]
+        else:
+            chunks = [idxs]
+        for chunk in chunks:
+            rows = len(chunk)
+            pad = (cap - rows) if len(chunks) > 1 else \
+                _autopad_rows(rows, mesh)
+            take = chunk + [chunk[-1]] * pad
+            design = {
+                kk: np.stack([packed_by_model[id(models[i])][1][kk]
+                              for i in take])
+                for kk in leaf_names}
+            case = dict(design=design, Hs=Hs[take], Tp=Tp[take],
+                        beta=beta[take])
+            in_sh = jax.tree_util.tree_map(lambda _: sharding, case)
+
+            def build(ev=ev, in_sh=in_sh, keys=tuple(out_keys)):
+                def one(c):
+                    with jax.named_scope("sweep_bucket"):
+                        return {kk: ev(c)[kk] for kk in keys}
+
+                return jax.jit(jax.vmap(one), in_shardings=(in_sh,))
+
+            # key[1] must stay the out_keys tuple: _cached_jit's
+            # sweep_program_built event logs it under that field name
+            fn = _cached_jit(
+                ev, ("bucket", tuple(out_keys), sig, _mesh_key(mesh),
+                     _flags_key()), build)
+            # host-numpy device_put: no resharding program (see
+            # sweep_cases)
+            args = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(np.asarray(x), s), case, in_sh)
+            with span("sweep_dispatch", kind="bucket", rows=rows,
+                      bucket=bucketing.signature_fingerprint(sig)):
+                res = fn(args)
+            # reassemble in input order (host scatter; padded rows
+            # dropped)
+            for kk in out_keys:
+                host = np.asarray(res[kk])[:rows]
+                if kk not in out:
+                    out[kk] = np.zeros((n,) + host.shape[1:],
+                                       dtype=host.dtype)
+                out[kk][chunk] = host
+    # waste is ROW-weighted (one packed entry per dispatched row, the
+    # README definition and what bench.py reports), not per distinct
+    # design — 990 floor-bucket rows + 10 big-semi rows must not log
+    # the unweighted 2-design mean
+    log_event("bucket_sweep", rows=n, n_buckets=len(groups),
+              n_designs=len(packed_by_model),
+              padding_waste_frac=round(bucketing.padding_waste_frac(
+                  [packed_by_model[id(m)][1] for m in models]), 4))
+    metrics.counter("bucket_sweeps").inc()
+    return out
 
 
 def run_sweep_checkpointed_full(evaluate, cases, out_dir, shard_size=256,

@@ -220,6 +220,30 @@ def solve_catenary(XF, ZF, L, w, EA, n_iter=60, can_ground=True):
 
 # ------------------------------------------------------------ body level
 
+def catenary_line_forces(r_fair0, r_anchor, L, w, EA, r6):
+    """Per-line 6-DOF fairlead force contributions about the body
+    origin at pose ``r6`` (catenary lines, no seabed friction), plus
+    per-line tension components.  Single source of the line-force
+    body: :func:`mooring_force` sums all lines, the shape-bucketed
+    masked closures (:mod:`raft_tpu.structure.bucketing`) sum under a
+    validity mask — both MUST trace identical per-line physics or the
+    bucketed solo-parity contract breaks."""
+    R = tf.rotation_matrix(r6[3], r6[4], r6[5])
+    r_fair = r6[:3] + jnp.asarray(r_fair0) @ R.T  # (nL, 3)
+    dvec = r_fair - jnp.asarray(r_anchor)
+    XF = jnp.sqrt(dvec[:, 0] ** 2 + dvec[:, 1] ** 2)
+    ZF = dvec[:, 2]
+    XF_safe = jnp.maximum(XF, 1e-8)
+    u_h = dvec[:, :2] / XF_safe[:, None]
+
+    HF, VF, HA, VA = jax.vmap(solve_catenary)(
+        XF, ZF, jnp.asarray(L), jnp.asarray(w), jnp.asarray(EA)
+    )
+    F_fair = jnp.concatenate([-HF[:, None] * u_h, -VF[:, None]], axis=1)  # (nL,3)
+    F6 = tf.translate_force_3to6(F_fair, r_fair - r6[:3])
+    return F6, dict(HF=HF, VF=VF, HA=HA, VA=VA)
+
+
 def mooring_force(ms, r6):
     """Net 6-DOF mooring force on the body at pose ``r6`` about the body
     origin (line forces only).  Accepts a MooringSystem or a one-body
@@ -229,20 +253,9 @@ def mooring_force(ms, r6):
         t = info["tensions"]  # (nL, 2) anchor/fairlead magnitudes
         return F[0], dict(HF=t[:, 1], VF=jnp.zeros_like(t[:, 1]),
                           HA=t[:, 0], VA=jnp.zeros_like(t[:, 0]))
-    R = tf.rotation_matrix(r6[3], r6[4], r6[5])
-    r_fair = r6[:3] + jnp.asarray(ms.r_fair0) @ R.T  # (nL, 3)
-    dvec = r_fair - jnp.asarray(ms.r_anchor)
-    XF = jnp.sqrt(dvec[:, 0] ** 2 + dvec[:, 1] ** 2)
-    ZF = dvec[:, 2]
-    XF_safe = jnp.maximum(XF, 1e-8)
-    u_h = dvec[:, :2] / XF_safe[:, None]
-
-    HF, VF, HA, VA = jax.vmap(solve_catenary)(
-        XF, ZF, jnp.asarray(ms.L), jnp.asarray(ms.w), jnp.asarray(ms.EA)
-    )
-    F_fair = jnp.concatenate([-HF[:, None] * u_h, -VF[:, None]], axis=1)  # (nL,3)
-    F6 = tf.translate_force_3to6(F_fair, r_fair - r6[:3])
-    return jnp.sum(F6, axis=0), dict(HF=HF, VF=VF, HA=HA, VA=VA)
+    F6, info = catenary_line_forces(ms.r_fair0, ms.r_anchor, ms.L, ms.w,
+                                    ms.EA, r6)
+    return jnp.sum(F6, axis=0), info
 
 
 def mooring_stiffness(ms, r6):

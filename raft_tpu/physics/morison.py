@@ -426,7 +426,11 @@ def drag_lin_precompute(fs, ss, hc, u_ih, Tn, r_nodes, w, dtype=None):
         H=tf.skew(r_off),
         u=u_ih, iw=1j * jnp.asarray(w),
     )
-    pre["node_idx"] = np.asarray(ss.node)     # static scatter targets
+    # scatter targets: static numpy for build-time StripSets, traced for
+    # bucketed designs where the strip->node map is itself a design
+    # input (segment_sum takes either)
+    pre["node_idx"] = (np.asarray(ss.node) if isinstance(ss.node, np.ndarray)
+                       else jnp.asarray(ss.node))
     pre["n_nodes"] = fs.n_nodes
 
     # Bmat is LINEAR in the three per-strip RMS coefficients c_d, so

@@ -212,6 +212,14 @@ _register(
          help="exceeding RAFT_TPU_COMPILE_BUDGET raises "
               "RecompilationError ('error') or only logs + counts "
               "('warn')"),
+    Flag("BUCKET_ROWS", "int", 512,
+         help="max rows per dispatched bucket program in "
+              "sweep_heterogeneous (0 = unlimited): larger signature "
+              "groups dispatch in fixed-size chunks of exactly this "
+              "many rows (dp-rounded, last chunk padded with masked "
+              "repeat rows), capping host/device memory for the packed "
+              "design batch at chunk x design instead of rows x design "
+              "while every chunk reuses ONE compiled program"),
     Flag("BEM_DIR", "str",
          default_factory=lambda: os.path.join(os.getcwd(), "_bem_cache"),
          help="panel-method BEM coefficient cache directory"),

@@ -1,0 +1,296 @@
+"""Shape-bucketed heterogeneous-design batching tests.
+
+The acceptance contract of the bucketing layer
+(:mod:`raft_tpu.structure.bucketing` +
+:func:`raft_tpu.parallel.sweep.sweep_heterogeneous`):
+
+* a mixed sweep over >=3 DISTINCT member layouts dispatches at most
+  ``n_buckets`` backend compilations (recompile-sentinel-asserted) and
+  a second identical sweep compiles nothing;
+* every row matches the solo per-design evaluation
+  (:func:`raft_tpu.api.make_case_evaluator`) to <=1e-10, INCLUDING the
+  int32 solver-health ``status`` word — padded strips/nodes/lines never
+  flip health bits, and dp-padding rows are dropped before any
+  quarantine logic can see them;
+* ragged batches auto-pad to dp-divisibility with masked rows (dropped
+  on gather) instead of raising, keeping a ``dp_autopad`` event.
+"""
+
+import copy
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import raft_tpu
+from raft_tpu.analysis.recompile import count_compilations
+from raft_tpu.api import make_case_evaluator
+from raft_tpu.parallel.sweep import (
+    make_mesh, sweep_cases, sweep_cases_full, sweep_heterogeneous)
+from raft_tpu.structure import bucketing
+from raft_tpu.structure.schema import load_design
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DESIGNS = os.path.join(HERE, "..", "raft_tpu", "designs")
+
+
+def _spar_variant_design():
+    """A spar with a DIFFERENT member layout (extra station, different
+    diameter schedule) that still packs into the spar's bucket."""
+    d = copy.deepcopy(load_design(os.path.join(DESIGNS, "spar_demo.yaml")))
+    mem = d["platform"]["members"][0]
+    mem["stations"] = [-120, -60, -12, -4, 10]
+    mem["d"] = [9.4, 9.4, 9.4, 6.5, 6.5]
+    mem["l_fill"] = [52.0, 0.0, 0.0, 0.0]
+    mem["rho_fill"] = [1850.0, 0.0, 0.0, 0.0]
+    mem["dlsMax"] = 4.0   # finer strips: different strip COUNT too
+    return d
+
+
+@pytest.fixture(scope="module")
+def trio():
+    """spar + spar-variant + MHK: three distinct member layouts, two
+    bucket signatures.  Packing here also forces the lazy host-side
+    hydro/statics builds, so the sweep tests count DISPATCH compiles
+    only (build-time eager ops are not sweep compiles)."""
+    models = [
+        raft_tpu.Model(os.path.join(DESIGNS, "spar_demo.yaml")),
+        raft_tpu.Model(_spar_variant_design()),
+        raft_tpu.Model(os.path.join(DESIGNS, "mhk_demo.yaml")),
+    ]
+    sigs = [bucketing.bucket_signature(m) for m in models]
+    for m, s in zip(models, sigs):
+        bucketing.pack_design(m, s)
+    return models, sigs
+
+
+# ------------------------------------------------------------ unit layer
+
+def test_ceil_pow2():
+    assert bucketing._ceil_pow2(1) == 1
+    assert bucketing._ceil_pow2(3) == 4
+    assert bucketing._ceil_pow2(16) == 16
+    assert bucketing._ceil_pow2(17) == 32
+    assert bucketing._ceil_pow2(3, floor=16) == 16
+
+
+def test_signature_and_shapes(trio):
+    models, sigs = trio
+    spar, spar2, mhk = models
+    # distinct layouts, shared bucket for the two spar variants
+    assert spar.hydro[0].strips.S != spar2.hydro[0].strips.S
+    assert sigs[0] == sigs[1]
+    assert sigs[2] != sigs[0]
+    meta = bucketing.signature_meta(sigs[0])
+    assert meta["S"] >= spar2.hydro[0].strips.S
+    assert meta["S"] & (meta["S"] - 1) == 0  # power of two
+    packed = bucketing.pack_design(spar, sigs[0])
+    assert packed["ds"].shape == (meta["S"], 2)
+    assert packed["Imat"].shape == (meta["S"], 3, 3, meta["nw"])
+    assert packed["node_r0"].shape == (meta["N"], 3)
+    assert packed["moor_L"].shape == (meta["L"],)
+    # masks mark exactly the real rows
+    assert packed["strip_mask"].sum() == spar.hydro[0].strips.S
+    assert packed["line_mask"].sum() == spar.ms.n_lines
+    # padded strips contribute nothing: zero coefficients and areas
+    pad = ~packed["strip_mask"]
+    assert not packed["active"][pad].any()
+    assert np.all(packed["ds"][pad] == 0)
+    assert np.all(packed["Cd_q"][pad] == 0)
+
+
+def test_padding_waste_frac(trio):
+    models, sigs = trio
+    packed = [bucketing.pack_design(m, s) for m, s in zip(models, sigs)]
+    w = bucketing.padding_waste_frac(packed)
+    assert 0.0 < w < 1.0
+    assert bucketing.padding_waste_frac([]) == 0.0
+
+
+def test_unbucketable_gates(trio):
+    models, _ = trio
+    spar = models[0]
+    from raft_tpu.physics.mooring import MooringNetwork
+
+    old = spar.ms_list[0]
+    try:
+        net = MooringNetwork(320.0).finalize()
+        spar.ms_list[0] = net
+        spar.ms = net
+        with pytest.raises(bucketing.UnbucketableDesignError):
+            bucketing.bucket_signature(spar)
+    finally:
+        spar.ms_list[0] = old
+        spar.ms = old
+
+
+def test_evaluator_is_stamped(trio):
+    _, sigs = trio
+    ev = bucketing.get_bucket_evaluator(sigs[0])
+    assert ev._raft_program_key[0] == "bucket_evaluator"
+    # process cache returns the same object (memoized sweep programs)
+    assert bucketing.get_bucket_evaluator(sigs[0]) is ev
+
+
+# --------------------------------------------- the acceptance invariant
+
+def test_mixed_sweep_parity_and_compile_budget(trio):
+    """Sweep over 3 distinct member layouts: <= n_buckets compiles,
+    zero on repeat, rows bit-compatible with solo evals including the
+    health status word."""
+    models, sigs = trio
+    n_buckets = len(set(sigs))
+    assert n_buckets == 2 < len(models)
+
+    rows = [models[i % 3] for i in range(5)]  # ragged on the dp=8 mesh
+    rng = np.random.default_rng(11)
+    n = len(rows)
+    Hs = 3.0 + 4.0 * rng.random(n)
+    Tp = 8.0 + 6.0 * rng.random(n)
+    beta = 0.5 * rng.random(n)
+    mesh = make_mesh(8)
+    keys = ("PSD", "X0", "Xi", "status")
+
+    with count_compilations() as clog:
+        out = sweep_heterogeneous(rows, Hs, Tp, beta, mesh=mesh,
+                                  out_keys=keys)
+    assert clog.real_count <= n_buckets
+
+    with count_compilations() as clog2:
+        out2 = sweep_heterogeneous(rows, Hs, Tp, beta, mesh=mesh,
+                                   out_keys=keys)
+    assert clog2.count == 0  # steady state: no backend events at all
+    for k in keys:
+        np.testing.assert_array_equal(out[k], out2[k])
+
+    # row-for-row parity vs the solo per-design evaluators
+    solo = {id(m): jax.jit(make_case_evaluator(m)) for m in set(rows)}
+    for i, m in enumerate(rows):
+        ref = solo[id(m)](Hs[i], Tp[i], beta[i])
+        for k in ("PSD", "X0", "Xi"):
+            np.testing.assert_allclose(
+                out[k][i], np.asarray(ref[k]), rtol=1e-10, atol=1e-12,
+                err_msg=f"row {i} key {k}")
+        # status words EXACTLY equal: padded strips/lines/rows never
+        # flip a health bit
+        assert int(out["status"][i]) == int(np.asarray(ref["status"]))
+    assert out["status"].dtype == np.int32
+
+
+@pytest.mark.slow
+def test_semi_joins_the_mix(trio):
+    """The bundled multi-column semi (8 members, its own bucket) rides
+    the same dispatcher and matches its solo evaluation."""
+    models, sigs = trio
+    semi = raft_tpu.Model(os.path.join(DESIGNS, "semi_demo.yaml"))
+    sig = bucketing.bucket_signature(semi)
+    assert sig not in set(sigs)
+    rows = [models[0], semi, models[2]]
+    Hs, Tp, beta = np.r_[5.0, 6.0, 3.0], np.r_[10.0, 12.0, 9.0], \
+        np.r_[0.0, 0.2, 0.4]
+    out = sweep_heterogeneous(rows, Hs, Tp, beta, mesh=make_mesh(8),
+                              out_keys=("X0", "PSD", "status"))
+    ref = jax.jit(make_case_evaluator(semi))(Hs[1], Tp[1], beta[1])
+    np.testing.assert_allclose(out["X0"][1], np.asarray(ref["X0"]),
+                               rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(out["PSD"][1], np.asarray(ref["PSD"]),
+                               rtol=1e-10, atol=1e-12)
+    assert int(out["status"][1]) == int(np.asarray(ref["status"]))
+
+
+def test_mixed_frequency_grids_rejected(trio):
+    models, _ = trio
+    d = copy.deepcopy(load_design(os.path.join(DESIGNS, "spar_demo.yaml")))
+    d["settings"]["max_freq"] = 0.15
+    other = raft_tpu.Model(d)
+    with pytest.raises(ValueError, match="frequency grids"):
+        sweep_heterogeneous([models[0], other], [5.0, 5.0], [10.0, 10.0],
+                            [0.0, 0.0], mesh=make_mesh(8))
+
+
+def test_bucket_rows_chunked_dispatch(trio, tmp_path, monkeypatch):
+    """RAFT_TPU_BUCKET_ROWS caps the materialized design batch: a
+    signature group larger than the cap dispatches in fixed-size
+    chunks (last chunk padded) that all share one program, and rows
+    still match the unchunked sweep."""
+    models, sigs = trio
+    rows = [models[i % 3] for i in range(20)]
+    rng = np.random.default_rng(7)
+    n = len(rows)
+    Hs = 3.0 + 4.0 * rng.random(n)
+    Tp = 8.0 + 6.0 * rng.random(n)
+    beta = 0.5 * rng.random(n)
+    mesh = make_mesh(8)
+    keys = ("X0", "PSD", "status")
+    ref = sweep_heterogeneous(rows, Hs, Tp, beta, mesh=mesh, out_keys=keys)
+    log = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", log)
+    monkeypatch.setenv("RAFT_TPU_BUCKET_ROWS", "8")
+    out = sweep_heterogeneous(rows, Hs, Tp, beta, mesh=mesh, out_keys=keys)
+    for k in ("X0", "PSD"):
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(ref[k]),
+                                   rtol=1e-10, atol=1e-12)
+    np.testing.assert_array_equal(out["status"], ref["status"])
+    with open(log) as f:
+        evs = [json.loads(x) for x in f if x.strip()]
+    disp = [e for e in evs if e["event"] == "span_begin"
+            and e.get("name") == "sweep_dispatch"]
+    # 14 spar-family rows -> chunks of [8, 6->8]; 6 MHK rows -> one
+    # dispatch under the cap
+    assert len(disp) == 3
+
+
+# --------------------------------------------------- dp auto-pad (toys)
+
+def _toy_case(h, t, b):
+    import jax.numpy as jnp
+
+    return {"PSD": jnp.stack([h, t, b]), "X0": h + t + b}
+
+
+def _toy_full(c):
+    import jax.numpy as jnp
+
+    return {"PSD": jnp.stack([c["Hs"], c["Tp"], c["Hs"] * c["Tp"]]),
+            "X0": c["Hs"] - c["Tp"]}
+
+
+def test_sweep_cases_autopads_ragged_batch(tmp_path, monkeypatch):
+    log = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", log)
+    mesh = make_mesh(8)
+    n = 5  # not divisible by dp=8
+    Hs = np.linspace(2.0, 4.0, n)
+    Tp = np.linspace(8.0, 10.0, n)
+    beta = np.zeros(n)
+    out = sweep_cases(_toy_case, Hs, Tp, beta, mesh=mesh,
+                      out_keys=("PSD", "X0"))
+    assert np.asarray(out["X0"]).shape == (n,)
+    np.testing.assert_allclose(np.asarray(out["X0"]), Hs + Tp + beta)
+    with open(log) as f:
+        evs = [json.loads(x) for x in f if x.strip()]
+    pads = [e for e in evs if e["event"] == "dp_autopad"]
+    assert pads and pads[0]["rows"] == n and pads[0]["pad"] == 3
+
+
+def test_sweep_cases_full_autopads_ragged_batch():
+    mesh = make_mesh(8)
+    n = 6
+    cases = dict(Hs=np.linspace(2.0, 4.0, n), Tp=np.linspace(8.0, 10.0, n))
+    out = sweep_cases_full(_toy_full, cases, mesh=mesh,
+                           out_keys=("PSD", "X0"))
+    assert np.asarray(out["PSD"]).shape == (n, 3)
+    np.testing.assert_allclose(np.asarray(out["X0"]),
+                               cases["Hs"] - cases["Tp"])
+
+
+def test_ragged_dict_and_empty_batch_still_rejected():
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError, match="ragged"):
+        sweep_cases_full(_toy_full, dict(Hs=np.ones(4), Tp=np.ones(3)),
+                         mesh=mesh)
+    with pytest.raises(ValueError, match="empty"):
+        sweep_cases(_toy_case, np.zeros(0), np.zeros(0), np.zeros(0),
+                    mesh=mesh)

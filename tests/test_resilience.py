@@ -334,12 +334,15 @@ def test_recomputed_clean_shard_clears_stale_quarantine(tmp_path):
 # --------------------------------------------- input validation satellites
 
 
-def test_batch_not_divisible_by_dp_is_clear_valueerror():
+def test_batch_not_divisible_by_dp_autopads():
+    """Ragged batches no longer raise: the tail is padded with masked
+    repeat rows and dropped on gather (full coverage incl. the warning
+    event lives in tests/test_bucketing.py)."""
     h = np.ones(3)
-    with pytest.raises(ValueError, match="divisible by the dp mesh-axis"):
-        sweep_cases(toy_case, h, h, h, mesh=mesh2())
-    with pytest.raises(ValueError, match="divisible by the dp mesh-axis"):
-        sweep_cases_full(toy_full, dict(Hs=h, Tp=h), mesh=mesh2())
+    out = sweep_cases(toy_case, h, h, h, mesh=mesh2())
+    assert np.asarray(out["X0"]).shape == (3,)
+    out = sweep_cases_full(toy_full, dict(Hs=h, Tp=h), mesh=mesh2())
+    assert np.asarray(out["X0"]).shape == (3,)
 
 
 def test_ragged_case_dict_rejected(tmp_path):
