@@ -647,7 +647,9 @@ def main():
     # headline when it finished; then the geometry-DoE; flat is the bank
     for mode in ("mixed", "geom", "flat"):
         if mode in results:
-            print(results[mode])
+            # leftover budget buys the fabric scaling block (1/2/4
+            # workers over the same sweep) in the headline breakdown
+            print(_attach_fabric(results[mode], budget, t_start))
             return
 
     # last resort: the accelerator backend may be unreachable (observed:
@@ -957,6 +959,11 @@ def _telemetry_block():
 
 def run_mode(mode):
     t_start = time.perf_counter()
+    if mode == "fabric":
+        # no _enable_compile_cache here: the fabric coordinator never
+        # touches jax — compile caching happens inside the workers
+        run_fabric_bench(t_start)
+        return
     _enable_compile_cache()
     from raft_tpu.obs.heartbeat import maybe_heartbeat
 
@@ -971,6 +978,183 @@ def run_mode(mode):
 
     with maybe_heartbeat():
         _run_geom(t_start)
+
+
+def fabric_bench_cases(n, seed=17):
+    """The bench fabric sweep's case batch: ``n`` DISTINCT designs
+    (per-row Cd_scale around the bundled spar) under varied sea states
+    — pure numpy so the coordinator never builds a model."""
+    rng = np.random.default_rng(seed)
+    n = int(n)
+    return {
+        "Hs": rng.uniform(2.0, 8.0, n),
+        "Tp": rng.uniform(6.0, 14.0, n),
+        "beta": rng.uniform(-0.5, 0.5, n),
+        "Cd_scale": rng.uniform(0.9, 1.1, n),
+    }
+
+
+def fabric_bench_entry(out_keys=("PSD", "X0", "status"), n=1024, seed=17,
+                       **_):
+    """Fabric worker entry for the bench scaling block: the bundled
+    spar's DESIGN evaluator (per-row drag-coefficient designs) through
+    the standard full_compute shard path.  Runs without
+    /root/reference."""
+    import raft_tpu
+    from raft_tpu import api
+    from raft_tpu.parallel.sweep import full_compute
+
+    design = os.path.join(os.path.dirname(os.path.abspath(
+        raft_tpu.__file__)), "designs", "spar_demo.yaml")
+    model = raft_tpu.Model(design)
+    evaluate = api.make_design_evaluator(model)
+    return {"compute": full_compute(evaluate, out_keys=tuple(out_keys)),
+            "cases": fabric_bench_cases(n, seed)}
+
+
+def run_fabric_bench(t_start=None):
+    """Measure the elastic fabric's design-evals/s scaling: the SAME
+    ≥256-design sweep at 1/2/4 local workers, each config a fresh
+    ledger + fresh worker subprocesses (ROADMAP item 2 acceptance).
+
+    Rates are reported over the sweep WINDOW (first shard start to
+    last shard completion, from the ledger's done records) so worker
+    cold start is visible separately (``wall_s``) instead of polluting
+    the throughput ratio.  Prints one JSON line ``{"fabric": block}``
+    that the parent bench folds into the headline breakdown."""
+    import shutil
+    import tempfile
+
+    from raft_tpu.parallel import fabric
+
+    t_start = t_start if t_start is not None else time.perf_counter()
+    n = int(config.get("BENCH_FABRIC_N"))
+    shard = int(config.get("BENCH_FABRIC_SHARD"))
+    counts = [int(w) for w in
+              str(config.get("BENCH_FABRIC_WORKERS")).split(",")
+              if w.strip()]
+    deadline = config.get("BENCH_DEADLINE_S")
+    out_keys = ("PSD", "X0", "status")
+    cases = fabric_bench_cases(n)
+    n_shards = (n + shard - 1) // shard
+    base = tempfile.mkdtemp(prefix="raft_fabric_bench_")
+    runs = {}
+    note = None
+    try:
+        # warmup pass (discarded): exports the shard program into the
+        # AOT bank / XLA disk cache so every measured config is equally
+        # warm — otherwise the 1-worker run eats the one-time compile
+        # and the multi-worker speedup is a cold-start artifact
+        fabric.run_fabric(
+            os.path.join(base, "warm"), workers=1,
+            entry="bench:fabric_bench_entry",
+            entry_kwargs={"n": 2 * shard, "out_keys": list(out_keys)},
+            cases=fabric_bench_cases(2 * shard), out_keys=out_keys,
+            shard_size=shard,
+            worker_env={"RAFT_TPU_AOT":
+                        config.raw("AOT") or "load"})
+        shutil.rmtree(os.path.join(base, "warm"), ignore_errors=True)
+        for w in counts:
+            if deadline and runs and \
+                    time.perf_counter() - t_start > 0.7 * deadline:
+                note = (f"budget exhausted after "
+                        f"{sorted(runs)} worker configs")
+                break
+            out_dir = os.path.join(base, f"w{w}")
+            t0 = time.perf_counter()
+            fabric.run_fabric(
+                out_dir, workers=w, entry="bench:fabric_bench_entry",
+                entry_kwargs={"n": n, "out_keys": list(out_keys)},
+                cases=cases, out_keys=out_keys, shard_size=shard,
+                worker_env={"RAFT_TPU_AOT":
+                            config.raw("AOT") or "load"})
+            wall = time.perf_counter() - t0
+            ledger = fabric.Ledger(out_dir, n_shards)
+            recs = [ledger.read_done(s) for s in range(n_shards)]
+            starts = [r["t"] - r.get("wall_s", 0.0) for r in recs if r]
+            ends = [r["t"] for r in recs if r]
+            window = max(1e-9, max(ends) - min(starts))
+            states = ledger.worker_states()
+
+            def csum(key):
+                return sum((st.get("counters") or {}).get(key, 0)
+                           for st in states.values())
+
+            runs[str(w)] = dict(
+                wall_s=round(wall, 2),
+                window_s=round(window, 2),
+                evals_per_s=round(n / window, 3),
+                evals_per_s_incl_startup=round(n / wall, 3),
+                steals=csum("shards_stolen"),
+                shard_retries=csum("shard_retries"),
+                programs_loaded=sum(st.get("programs_loaded") or 0
+                                    for st in states.values()),
+                programs_compiled=sum(st.get("programs_compiled") or 0
+                                      for st in states.values()),
+            )
+            shutil.rmtree(out_dir, ignore_errors=True)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+    block = dict(
+        workload=f"spar_demo design sweep: {n} distinct Cd_scale "
+                 f"designs, shard {shard}",
+        n_designs=n, shard_size=shard, host_cores=os.cpu_count(),
+        workers=runs,
+    )
+    r1 = runs.get("1")
+    if r1:
+        block["speedup_vs_1"] = {
+            w: round(r["evals_per_s"] / r1["evals_per_s"], 2)
+            for w, r in runs.items()}
+        block["scaling_efficiency"] = {
+            w: round(r["evals_per_s"] / (int(w) * r1["evals_per_s"]), 2)
+            for w, r in runs.items()}
+    cores = os.cpu_count() or 1
+    if counts and cores < max(counts):
+        note = ((note + "; ") if note else "") + (
+            f"host exposes {cores} physical core(s): XLA-bound work "
+            f"cannot exceed ~1x process-level scaling here; the "
+            f"fabric's speedup needs >=1 core (or device) per worker")
+    if note:
+        block["note"] = note
+    print(json.dumps({"fabric": block}))
+    return block
+
+
+def _attach_fabric(line, budget, t_start):
+    """Run the fabric scaling mode in a subprocess with the leftover
+    budget and fold its block into the headline JSON line."""
+    import subprocess
+    import sys
+
+    if not config.get("BENCH_FABRIC"):
+        return line
+    remaining = budget - (time.perf_counter() - t_start) - 10.0
+    if remaining < 120.0:
+        return line
+    env = dict(os.environ, RAFT_TPU_BENCH_MODE="fabric",
+               RAFT_TPU_BENCH_DEADLINE_S=repr(remaining))
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            timeout=remaining, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return line
+    block = None
+    for out_line in reversed((p.stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(out_line)
+        except Exception:
+            continue
+        if isinstance(parsed, dict) and "fabric" in parsed:
+            block = parsed["fabric"]
+            break
+    if block is None:
+        return line
+    result = json.loads(line)
+    result.setdefault("breakdown", {})["fabric"] = block
+    return json.dumps(result)
 
 
 def run_mixed(t_start):

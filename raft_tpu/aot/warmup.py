@@ -26,6 +26,13 @@ program plus one padded tail, so warm the sizes you will serve
 (``--n 512,8``).  Custom closures (e.g. ``sweep_10k.py``'s per-design
 summary evaluator) self-warm instead: their first ``RAFT_TPU_AOT=load``
 run exports, every later process loads.
+
+Fabric workers (:mod:`raft_tpu.parallel.fabric`) call
+:func:`warmup_model` before their FIRST shard claim when the sweep
+spec names a warmup block and ``RAFT_TPU_AOT`` is armed — a worker
+joining mid-sweep answers its first shard from the bank (its
+``fabric_worker_start`` event reports ``programs_compiled=0`` on a
+warmed bank) instead of stalling the ledger behind a 25s+ trace.
 """
 
 from __future__ import annotations

@@ -31,9 +31,10 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         ("name", "wall_s", "ok", "error?"),
         "the matching span closed; error carries repr(exc) on failure"),
     "heartbeat": (
-        ("devices", "live_arrays", "progress?"),
+        ("devices", "live_arrays", "progress?", "worker_id?", "leases?"),
         "periodic device sampler: per-device memory_stats, live-buffer "
-        "count, sweep shard progress (RAFT_TPU_HEARTBEAT_S)"),
+        "count, sweep shard progress (RAFT_TPU_HEARTBEAT_S); fabric "
+        "workers add their id and currently-held shard leases"),
     "metrics_snapshot": (
         ("snapshot",),
         "full metrics-registry snapshot (emitted at sweep_done; also "
@@ -89,6 +90,53 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
     "shard_escalate_failed": (
         ("shard", "index", "rung", "error"),
         "an escalation rung raised instead of returning a result"),
+    # ------------------------------------------------------- sweep fabric
+    "fabric_init": (
+        ("out_dir", "n_cases", "n_shards", "shard_size", "entry"),
+        "fabric sweep spec + case arrays + lease ledger initialized "
+        "under <out_dir>/_fabric (raft_tpu.parallel.fabric)"),
+    "fabric_worker_spawn": (
+        ("out_dir", "worker", "pid"),
+        "coordinator spawned one worker subprocess"),
+    "fabric_worker_start": (
+        ("out_dir", "worker", "n_shards", "programs_loaded",
+         "programs_compiled", "warmup_s?"),
+        "a fabric worker is ready to claim shards (after jax init, "
+        "entry build and optional AOT-bank warmup; a mid-sweep joiner "
+        "on a warmed bank must report programs_compiled=0)"),
+    "fabric_worker_done": (
+        ("out_dir", "worker", "shards_done", "shards_resumed", "rows",
+         "wall_s", "programs_loaded", "programs_compiled"),
+        "a fabric worker found the ledger drained and exited cleanly"),
+    "fabric_worker_exit": (
+        ("out_dir", "worker", "returncode"),
+        "a spawned worker subprocess exited (nonzero returncode with "
+        "the sweep incomplete means its leases will expire and be "
+        "stolen)"),
+    "shard_claim": (
+        ("shard", "worker", "attempt"),
+        "a worker claimed one shard lease (O_CREAT|O_EXCL on the "
+        "lease file: exactly one claimant wins)"),
+    "shard_steal": (
+        ("shard", "worker", "from_worker", "reason", "age_s"),
+        "an expired/stale/straggling lease was atomically removed so "
+        "the shard can be re-claimed (reason: expired | holder_stale "
+        "| straggler)"),
+    "fabric_assemble": (
+        ("out_dir", "n_shards", "n_workers", "n_quarantined",
+         "n_flagged", "wall_s"),
+        "coordinator validated every shard, merged worker quarantine "
+        "records and wrote the final manifest/metrics"),
+    "fabric_unavailable": (
+        ("out_dir", "reason"),
+        "RAFT_TPU_FABRIC_WORKERS requested but the sweep cannot run "
+        "on the fabric (no entry spec on the evaluator); falling back "
+        "to the serial in-process path"),
+    "distributed_init": (
+        ("coordinator", "process_id", "num_processes", "dryrun"),
+        "jax.distributed.initialize wiring for multi-host meshes "
+        "(RAFT_TPU_DIST; dryrun validates the config without touching "
+        "a backend)"),
     "backend_fallback": (
         ("from_platform", "to_platform", "forced_by_fault"),
         "accelerator unhealthy; sweep pinned to the CPU backend"),

@@ -68,11 +68,17 @@ class Heartbeat(threading.Thread):
     snapshots it, so the heartbeat stream doubles as a liveness probe
     for the sweep itself."""
 
-    def __init__(self, interval_s, devices=None, progress=None):
+    def __init__(self, interval_s, devices=None, progress=None,
+                 worker_id=None, leases=None):
         super().__init__(name="raft-tpu-heartbeat", daemon=True)
         self.interval_s = float(interval_s)
         self.devices = list(devices) if devices is not None else None
         self.progress = progress
+        # fabric liveness: each beat carries the worker id and the
+        # shard leases it currently holds, so a captured stream shows
+        # who was alive holding what when a lease later expired
+        self.worker_id = worker_id
+        self.leases = leases  # callable -> list of held shard ids
         self.beats = 0
         # NB: not `_stop` — threading.Thread uses that name internally
         self._stop_evt = threading.Event()
@@ -97,6 +103,13 @@ class Heartbeat(threading.Thread):
         kw = {}
         if self.progress:
             kw["progress"] = dict(self.progress)
+        if self.worker_id is not None:
+            kw["worker_id"] = self.worker_id
+        if self.leases is not None:
+            try:
+                kw["leases"] = sorted(self.leases())
+            except Exception:  # ledger mid-mutation: beat without leases
+                pass
         log_event("heartbeat", devices=rows, live_arrays=live, **kw)
         self.beats += 1
 
@@ -124,14 +137,16 @@ class Heartbeat(threading.Thread):
 
 
 @contextlib.contextmanager
-def maybe_heartbeat(devices=None, progress=None):
+def maybe_heartbeat(devices=None, progress=None, worker_id=None,
+                    leases=None):
     """Start a :class:`Heartbeat` for the block when
     ``RAFT_TPU_HEARTBEAT_S`` > 0, else yield ``None`` at zero cost."""
     interval = config.get("HEARTBEAT_S")
     if not interval or interval <= 0:
         yield None
         return
-    hb = Heartbeat(interval, devices=devices, progress=progress)
+    hb = Heartbeat(interval, devices=devices, progress=progress,
+                   worker_id=worker_id, leases=leases)
     hb.start()
     try:
         yield hb

@@ -130,8 +130,10 @@ class Histogram:
                 if acc >= target and n:
                     hi = (BUCKET_BOUNDS[i] if i < len(BUCKET_BOUNDS)
                           else self.max)
+                    if self.min is None or self.max is None or hi is None:
+                        return None if hi is None else float(hi)
                     return float(min(max(hi, self.min), self.max))
-            return float(self.max)
+            return None if self.max is None else float(self.max)
 
     def snapshot(self):
         with self._lock:
@@ -158,6 +160,63 @@ class Histogram:
             acc += n
             out.append((bound, acc))
         return out
+
+    def state(self):
+        """JSON-portable raw state (sparse bucket counts) for
+        cross-process pooling: fabric workers publish their
+        ``shard_wall_s`` state in the ledger's worker status files, and
+        a stealer merges every worker's state (:func:`merge_states`) to
+        get the fleet-wide p95 the straggler threshold needs — bucket
+        counts add exactly, unlike p95s."""
+        with self._lock:
+            return {
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min,
+                "max": self.max,
+                "buckets": {str(i): n for i, n in enumerate(self._buckets)
+                            if n},
+            }
+
+    def merge_state(self, state):
+        """Fold one :meth:`state` dict (from another process) into this
+        histogram.  Unknown/garbled states are ignored rather than
+        poisoning the pool — a steal decision must never crash on a
+        half-written status file."""
+        try:
+            count = int(state["count"])
+            if count <= 0:
+                return
+            buckets = {int(i): int(n)
+                       for i, n in (state.get("buckets") or {}).items()}
+            smin = (None if state.get("min") is None
+                    else float(state["min"]))
+            smax = (None if state.get("max") is None
+                    else float(state["max"]))
+            ssum = float(state.get("sum", 0.0))
+            if smin is None or smax is None:
+                # count>0 with no extrema (schema drift / stringified
+                # payload): fall back to the occupied buckets' bounds
+                # so percentile() always has a clamp range
+                occupied = [i for i, n in buckets.items()
+                            if n and 0 <= i <= len(BUCKET_BOUNDS)]
+                if not occupied:
+                    return
+                smin = BUCKET_BOUNDS[max(min(occupied) - 1, 0)]
+                smax = BUCKET_BOUNDS[min(max(occupied),
+                                         len(BUCKET_BOUNDS) - 1)]
+        except (KeyError, TypeError, ValueError):
+            return
+        with self._lock:
+            self.count += count
+            self.sum += ssum
+            if self.min is None or smin < self.min:
+                self.min = smin
+            if self.max is None or smax > self.max:
+                self.max = smax
+            for i, n in buckets.items():
+                if 0 <= i < len(self._buckets):
+                    self._buckets[i] += n
 
 
 _REGISTRY_LOCK = threading.Lock()
@@ -186,6 +245,18 @@ def gauge(name) -> Gauge:
 
 def histogram(name) -> Histogram:
     return _get(name, Histogram)
+
+
+def merge_states(states, name="merged"):
+    """Pool several :meth:`Histogram.state` dicts into one fresh
+    (unregistered) histogram — the fabric's fleet-wide ``shard_wall_s``
+    view.  Returns the pooled :class:`Histogram` (query ``.count`` /
+    ``.percentile``)."""
+    h = Histogram(name)
+    for s in states:
+        if s:
+            h.merge_state(s)
+    return h
 
 
 def reset():

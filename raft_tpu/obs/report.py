@@ -191,6 +191,38 @@ def render_report(events, n_bad=0, source="<events>"):
                     f"  {name:38s} {h['count']:6d}  {h.get('mean')}  "
                     f"{h.get('p50')}  {h.get('p95')}  {h.get('max')}")
 
+    # fabric per-worker table: every record a worker emits is stamped
+    # worker=<id> (RAFT_TPU_WORKER_ID via structlog), so one shared
+    # capture splits cleanly into per-worker shard/latency rows
+    workers = {}
+    for e in events:
+        w = e.get("worker")
+        if not w:
+            continue
+        rec = workers.setdefault(
+            w, {"walls": [], "claims": 0, "steals": 0, "resumes": 0})
+        if e["event"] == "shard_done":
+            rec["walls"].append(e.get("wall_s") or 0.0)
+        elif e["event"] == "shard_claim":
+            rec["claims"] += 1
+        elif e["event"] == "shard_steal":
+            rec["steals"] += 1
+        elif e["event"] == "shard_resume":
+            rec["resumes"] += 1
+    if any(r["claims"] or r["walls"] for r in workers.values()):
+        out.append("")
+        out.append("fabric workers (shards / claims / steals / resumes / "
+                   "total / p50 / p95)")
+        for w in sorted(workers):
+            r = workers[w]
+            walls = r["walls"]
+            out.append(
+                f"  {w:20s} {len(walls):6d} {r['claims']:6d} "
+                f"{r['steals']:6d} {r['resumes']:7d} "
+                f"{_fmt_s(sum(walls) if walls else None)} "
+                f"{_fmt_s(_percentile(walls, 0.50))} "
+                f"{_fmt_s(_percentile(walls, 0.95))}")
+
     counts = {}
     for e in events:
         counts[e["event"]] = counts.get(e["event"], 0) + 1

@@ -3,10 +3,13 @@
 ``raft_tpu.parallel.sweep``       GSPMD sweep drivers (vmap + shardings)
 ``raft_tpu.parallel.resilience``  atomic checkpoints, manifest-validated
                                   resume, retry/backoff, NaN quarantine
+``raft_tpu.parallel.fabric``      elastic multi-worker sweep fabric:
+                                  lease-based shard ledger, work
+                                  stealing, coordinator/worker CLI
 """
 
 from raft_tpu.parallel.resilience import (  # noqa: F401
     ManifestMismatchError, ShardCorruptError, load_quarantine)
 from raft_tpu.parallel.sweep import (  # noqa: F401
-    make_mesh, run_sweep_checkpointed, run_sweep_checkpointed_full,
-    sweep_cases, sweep_cases_full)
+    case_compute, full_compute, make_mesh, run_sweep_checkpointed,
+    run_sweep_checkpointed_full, sweep_cases, sweep_cases_full)

@@ -167,14 +167,15 @@ def load_shard(path, out_keys, expect_rows=None):
 # ----------------------------------------------------------------- manifest
 
 
-def compute_fingerprint(cases, out_keys, shard_size, mesh):
+def compute_fingerprint(cases, out_keys, shard_size, mesh=None):
     """Config fingerprint of one checkpointed sweep.
 
     ``case_hashes`` digests each case array's dtype+shape+bytes, so any
     change to the inputs — values, order, length — changes the
     fingerprint.  Mesh shape and package version are recorded for audit
     but compared only advisorily (results do not depend on device
-    layout)."""
+    layout; a fabric coordinator fingerprints with ``mesh=None`` — it
+    never initializes a backend — and each worker records its own)."""
     import raft_tpu
 
     case_hashes = {}
@@ -190,8 +191,9 @@ def compute_fingerprint(cases, out_keys, shard_size, mesh):
         "n_cases": int(len(next(iter(cases.values())))),
         "out_keys": list(out_keys),
         "shard_size": int(shard_size),
-        "mesh_shape": [int(s) for s in mesh.devices.shape],
-        "mesh_axes": list(mesh.axis_names),
+        "mesh_shape": ([int(s) for s in mesh.devices.shape]
+                       if mesh is not None else []),
+        "mesh_axes": (list(mesh.axis_names) if mesh is not None else []),
         "package_version": getattr(raft_tpu, "__version__", "unknown"),
     }
 
@@ -250,6 +252,35 @@ def init_manifest(out_dir, fingerprint, n_shards):
     }
     _atomic_json(path, manifest)
     return manifest
+
+
+def validate_manifest(out_dir, fingerprint):
+    """Read-only strict-fingerprint check against an existing manifest.
+
+    The concurrent-safe face of :func:`init_manifest` for fabric
+    workers: N workers validating the same ``out_dir`` must not race
+    each other with advisory-field rewrites (last-writer-wins would
+    drop another worker's adoption), so this only *reads* — a strict
+    mismatch raises :class:`ManifestMismatchError`, advisory drift is
+    ignored (every worker's mesh legitimately differs).  A missing
+    manifest is an error: the coordinator writes it at init, so its
+    absence means ``out_dir`` was never fabric-initialized."""
+    path = _manifest_path(out_dir)
+    try:
+        with open(path) as f:
+            old = json.load(f)["fingerprint"]
+    except Exception as e:
+        raise ManifestMismatchError(
+            f"{path} is missing or unreadable ({e}); this out_dir was "
+            "not initialized for this sweep") from e
+    mismatched = [k for k in _STRICT_FINGERPRINT_KEYS
+                  if old.get(k) != fingerprint[k]]
+    if mismatched:
+        log_event("manifest_mismatch", out_dir=out_dir, fields=mismatched,
+                  fatal=True)
+        raise ManifestMismatchError(
+            f"fingerprint mismatch in {path} on fields {mismatched}: "
+            "this worker's sweep spec differs from the ledger's")
 
 
 def mark_shard(manifest, out_dir, shard, status, **extra):
@@ -555,6 +586,12 @@ def resolve_mesh(make_mesh, mesh=None):
     global _PROBE_VERDICT
     if mesh is not None:
         return mesh
+    # multi-host pods: RAFT_TPU_DIST wires jax.distributed.initialize
+    # in BEFORE any backend init, so make_mesh() sees the global device
+    # set (jax.devices() spans every process after initialize)
+    from raft_tpu.parallel.sweep import ensure_distributed
+
+    ensure_distributed()
     from raft_tpu.utils.devices import probe_backend
 
     # an installed accelerator plugin (axon) selects its platform with
@@ -653,6 +690,54 @@ def _dump_metrics(out_dir, manifest, counters0):
     return snap
 
 
+def evaluate_shard(compute, chunk, shard, offset, mesh, max_retries=3,
+                   backoff_s=0.5, quarantine_retry=True, on_result=None):
+    """One shard's full fault-tolerant evaluation — the unit of work
+    shared by the serial checkpointed runner and the fabric workers
+    (:mod:`raft_tpu.parallel.fabric`), so an N-worker sweep judges and
+    records a shard EXACTLY like the serial path does.
+
+    Orchestration: retry/backoff/OOM-halving eval -> injected-NaN
+    fault -> non-finite + status-flagged row quarantine/escalation ->
+    ``on_result(out, entries)`` (the caller persists the shard inside
+    the shard span so write time stays on the telemetry tree) ->
+    counters + the ``shard_wall_s`` histogram (which feeds the fabric's
+    straggler-steal threshold).  Returns ``(out, entries, wall_s)``."""
+    rows = len(next(iter(chunk.values())))
+    with span("shard", shard=shard, rows=rows):
+        log_event("shard_start", shard=shard, rows=rows)
+        t_sh = time.perf_counter()
+        out = eval_with_recovery(
+            lambda c: {k: np.asarray(v)[: len(next(iter(c.values())))]
+                       for k, v in compute(c, mesh).items()},
+            chunk, shard, max_retries=max_retries, backoff_s=backoff_s)
+        if faults.take("nan", "shard_result"):
+            for k, v in out.items():
+                a = np.array(v)
+                if np.issubdtype(a.dtype, np.inexact):
+                    a[0] = np.nan
+                    out[k] = a
+        bad = nonfinite_rows(out)
+        flagged = flagged_rows(out)
+        entries = []
+        if bad.size or flagged.size:
+            out, entries = _quarantine_shard(
+                compute, chunk, out, bad, flagged, shard, offset, mesh,
+                retry_solo=quarantine_retry)
+        if on_result is not None:
+            on_result(out, entries)
+        wall = time.perf_counter() - t_sh
+        metrics.counter("shards_done").inc()
+        metrics.counter("rows_evaluated").inc(rows)
+        metrics.counter("rows_quarantined").inc(
+            sum(1 for e in entries if not e.get("resolved")))
+        metrics.counter("rows_flagged").inc(len(flagged_rows(out)))
+        metrics.histogram("shard_wall_s").observe(wall)
+        log_event("shard_done", shard=shard, rows=rows,
+                  wall_s=round(wall, 3))
+    return out, entries, wall
+
+
 def run_checkpointed(compute, cases, out_dir, shard_size, mesh, out_keys,
                      on_shard=None, max_retries=3, backoff_s=0.5,
                      quarantine_retry=True):
@@ -677,6 +762,30 @@ def run_checkpointed(compute, cases, out_dir, shard_size, mesh, out_keys,
         raise ValueError(
             f"ragged case dict: all case arrays must have equal length, "
             f"got {lengths}")
+
+    # elastic fabric path: RAFT_TPU_FABRIC_WORKERS > 1 routes the sweep
+    # through N worker subprocesses claiming shards from the lease
+    # ledger — zero caller changes, same out_dir layout, same results.
+    # Needs a fabric entry spec on the compute closure (the sweep
+    # drivers propagate it from the evaluator's `_raft_fabric_entry`
+    # stamp) so workers can rebuild the evaluator in their own process.
+    workers = int(config.get("FABRIC_WORKERS") or 0)
+    if workers > 1:
+        spec = getattr(compute, "_raft_fabric_entry", None)
+        if spec:
+            from raft_tpu.parallel import fabric
+
+            return fabric.run_fabric(
+                out_dir, workers=workers, entry=spec["entry"],
+                entry_kwargs=spec.get("kwargs"), warmup=spec.get("warmup"),
+                cases=cases, out_keys=out_keys, shard_size=shard_size,
+                on_shard=on_shard, max_retries=max_retries,
+                backoff_s=backoff_s, quarantine_retry=quarantine_retry)
+        log_event("fabric_unavailable", out_dir=out_dir,
+                  reason="RAFT_TPU_FABRIC_WORKERS set but the evaluator "
+                         "carries no _raft_fabric_entry spec; running "
+                         "serial in-process")
+
     n = next(iter(lengths.values()))
     n_shards = (n + shard_size - 1) // shard_size
 
@@ -734,53 +843,33 @@ def run_checkpointed(compute, cases, out_dir, shard_size, mesh, out_keys,
                         os.unlink(path)
                     except OSError:
                         pass
-            with span("shard", shard=s, rows=rows):
-                log_event("shard_start", shard=s, rows=rows)
-                mark_shard(manifest, out_dir, s, "running")
-                t_sh = time.perf_counter()
-                chunk = {k: v[sl] for k, v in cases.items()}
-                out = eval_with_recovery(
-                    lambda c: {k: np.asarray(v)[: len(next(iter(c.values())))]
-                               for k, v in compute(c, mesh).items()},
-                    chunk, s, max_retries=max_retries, backoff_s=backoff_s)
-                if faults.take("nan", "shard_result"):
-                    for k, v in out.items():
-                        a = np.array(v)
-                        if np.issubdtype(a.dtype, np.inexact):
-                            a[0] = np.nan
-                            out[k] = a
-                bad = nonfinite_rows(out)
-                flagged = flagged_rows(out)
-                entries = []
-                if bad.size or flagged.size:
-                    out, entries = _quarantine_shard(
-                        compute, chunk, out, bad, flagged, s, sl.start, mesh,
-                        retry_solo=quarantine_retry)
-                # re-judge even when clean: a recomputed shard must clear
-                # its own stale quarantine entries from a previous run (no
-                # file is created for sweeps that never quarantined
-                # anything)
-                if entries or os.path.exists(_quarantine_path(out_dir)):
-                    record_quarantine(out_dir, s, entries)
-                # rows still bad after recovery/escalation (resolved
-                # escalation entries are audit records, not quarantined
-                # rows)
-                shard_quarantined = sum(
-                    1 for e in entries if not e.get("resolved"))
-                n_quarantined += shard_quarantined
-                shard_flagged = len(flagged_rows(out))  # severe bits left
-                n_flagged += shard_flagged
-                atomic_savez(path, **out)
-                mark_shard(manifest, out_dir, s, "done",
-                           wall_s=round(time.perf_counter() - t_sh, 3),
-                           quarantined=shard_quarantined,
-                           flagged=shard_flagged)
-                metrics.counter("shards_done").inc()
-                metrics.counter("rows_evaluated").inc(rows)
-                metrics.counter("rows_quarantined").inc(shard_quarantined)
-                metrics.counter("rows_flagged").inc(shard_flagged)
-                log_event("shard_done", shard=s, rows=rows,
-                          wall_s=round(time.perf_counter() - t_sh, 3))
+            mark_shard(manifest, out_dir, s, "running")
+            chunk = {k: v[sl] for k, v in cases.items()}
+
+            def persist(out_, entries_, _s=s, _path=path):
+                # re-judge even when clean: a recomputed shard must
+                # clear its own stale quarantine entries from a
+                # previous run (no file is created for sweeps that
+                # never quarantined anything)
+                if entries_ or os.path.exists(_quarantine_path(out_dir)):
+                    record_quarantine(out_dir, _s, entries_)
+                atomic_savez(_path, **out_)
+
+            out, entries, wall = evaluate_shard(
+                compute, chunk, s, sl.start, mesh, max_retries=max_retries,
+                backoff_s=backoff_s, quarantine_retry=quarantine_retry,
+                on_result=persist)
+            # rows still bad after recovery/escalation (resolved
+            # escalation entries are audit records, not quarantined rows)
+            shard_quarantined = sum(
+                1 for e in entries if not e.get("resolved"))
+            n_quarantined += shard_quarantined
+            shard_flagged = len(flagged_rows(out))  # severe bits left
+            n_flagged += shard_flagged
+            mark_shard(manifest, out_dir, s, "done",
+                       wall_s=round(wall, 3),
+                       quarantined=shard_quarantined,
+                       flagged=shard_flagged)
             results.append(out)
             progress["shards_done"] = s + 1
             if on_shard is not None:

@@ -115,6 +115,60 @@ def _cached_jit(evaluate, key, build):
     return per[key]
 
 
+def ensure_distributed(dryrun=False):
+    """Wire ``jax.distributed.initialize`` in for multi-host meshes.
+
+    Gated on ``RAFT_TPU_DIST``; coordinator address / process id /
+    process count come from the ``RAFT_TPU_DIST_*`` flags (set them
+    per host in the pod launcher).  Must run before the first backend
+    init — after it, ``jax.devices()`` (and therefore
+    :func:`make_mesh`) spans every process's devices and GSPMD inserts
+    the cross-host collectives itself.  ``dryrun=True`` validates and
+    returns the parsed config without touching jax (the CI-testable
+    path on a single-host CPU container).  Returns the config dict, or
+    ``None`` when distribution is off.  Idempotent: a second call in
+    an already-initialized process is a no-op."""
+    from raft_tpu.utils import config
+
+    if not config.get("DIST"):
+        return None
+    cfg = {
+        "coordinator": str(config.get("DIST_COORDINATOR")),
+        "process_id": int(config.get("DIST_PROCESS_ID")),
+        "num_processes": int(config.get("DIST_NUM_PROCESSES")),
+    }
+    if ":" not in cfg["coordinator"]:
+        raise ValueError(
+            f"RAFT_TPU_DIST_COORDINATOR={cfg['coordinator']!r}: expected "
+            "host:port")
+    if not 0 <= cfg["process_id"] < cfg["num_processes"]:
+        raise ValueError(
+            f"RAFT_TPU_DIST_PROCESS_ID={cfg['process_id']} out of range "
+            f"for RAFT_TPU_DIST_NUM_PROCESSES={cfg['num_processes']}")
+    log_event("distributed_init", coordinator=cfg["coordinator"],
+              process_id=cfg["process_id"],
+              num_processes=cfg["num_processes"], dryrun=bool(dryrun))
+    if dryrun:
+        return cfg
+    if _DIST_DONE[0]:
+        return cfg  # already initialized (resume / second sweep)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=cfg["coordinator"],
+            num_processes=cfg["num_processes"],
+            process_id=cfg["process_id"])
+    except RuntimeError as e:
+        # e.g. initialize() called twice by an outer launcher — the
+        # runtime is already distributed, which is what we wanted
+        if "already" not in str(e).lower():
+            raise
+    _DIST_DONE[0] = True
+    return cfg
+
+
+_DIST_DONE = [False]
+
+
 def make_mesh(n_devices=None, axis_names=("dp",)):
     devices = np.array(jax.devices()[: n_devices or len(jax.devices())])
     if len(axis_names) == 1:
@@ -413,6 +467,78 @@ def sweep_heterogeneous(models, Hs, Tp, beta, mesh=None,
     return out
 
 
+def full_compute(evaluate, out_keys=("PSD", "X0"), shard_freq=False):
+    """The per-shard compute callable of the FULL checkpointed driver:
+    ``compute(chunk_dict, mesh) -> dict`` padding the chunk to the
+    device count and dispatching :func:`sweep_cases_full`.
+
+    Module-level (not a driver-internal closure) so the serial runner
+    and every fabric worker (:mod:`raft_tpu.parallel.fabric`) evaluate
+    shards through the IDENTICAL code path — the N-worker sweep is
+    bit-identical to the serial one by construction.  The evaluator's
+    fabric entry stamp (``_raft_fabric_entry``) is propagated onto the
+    returned callable so :func:`raft_tpu.parallel.resilience.
+    run_checkpointed` can route the sweep onto the fabric."""
+    def compute(chunk, mesh_):
+        ndev = mesh_.devices.size
+        pad = (-len(next(iter(chunk.values())))) % ndev
+        if pad:
+            chunk = {k: np.concatenate([v, np.repeat(v[-1:], pad, 0)])
+                     for k, v in chunk.items()}
+        return sweep_cases_full(evaluate, chunk, mesh=mesh_,
+                                out_keys=out_keys, shard_freq=shard_freq)
+
+    _stamp_fabric(compute, evaluate, out_keys, shard_freq=shard_freq)
+    return compute
+
+
+def case_compute(evaluate, out_keys=("PSD", "X0")):
+    """Per-shard compute of the legacy (Hs, Tp, beta) checkpointed
+    driver — see :func:`full_compute` for why this is module-level."""
+    def compute(chunk, mesh_):
+        ndev = mesh_.devices.size
+        h, t, b = chunk["Hs"], chunk["Tp"], chunk["beta"]
+        pad = (-len(h)) % ndev  # pad the tail shard to the device count
+        if pad:
+            h = np.concatenate([h, np.full(pad, h[-1])])
+            t = np.concatenate([t, np.full(pad, t[-1])])
+            b = np.concatenate([b, np.full(pad, b[-1])])
+        return sweep_cases(evaluate, h, t, b, mesh=mesh_, out_keys=out_keys)
+
+    _stamp_fabric(compute, evaluate, out_keys)
+    return compute
+
+
+def _routes_to_fabric(evaluate):
+    """True when the checkpointed runner will hand this sweep to the
+    worker fabric — mirrors the routing condition in
+    ``resilience.run_checkpointed`` so the drivers can skip resolving
+    a mesh (and the jax backend init it costs) in a coordinator that
+    never dispatches a program itself."""
+    from raft_tpu.utils import config
+
+    return (int(config.get("FABRIC_WORKERS") or 0) > 1
+            and getattr(evaluate, "_raft_fabric_entry", None) is not None)
+
+
+def _stamp_fabric(compute, evaluate, out_keys, shard_freq=False):
+    """Copy the evaluator's fabric entry spec onto its compute closure,
+    folding in the call-time sweep arguments (out_keys, shard_freq) so
+    a worker's entry rebuilds the SAME sweep the caller requested.  An
+    evaluator without a stamp simply cannot run on the fabric (the
+    ledger ships an importable entry, never a pickled closure)."""
+    spec = getattr(evaluate, "_raft_fabric_entry", None)
+    if not spec:
+        return
+    compute._raft_fabric_entry = {
+        "entry": spec["entry"],
+        "kwargs": {**(spec.get("kwargs") or {}),
+                   "out_keys": list(out_keys),
+                   "shard_freq": bool(shard_freq)},
+        "warmup": spec.get("warmup"),
+    }
+
+
 def run_sweep_checkpointed_full(evaluate, cases, out_dir, shard_size=256,
                                 mesh=None, out_keys=("PSD", "X0"),
                                 shard_freq=False, on_shard=None,
@@ -443,23 +569,17 @@ def run_sweep_checkpointed_full(evaluate, cases, out_dir, shard_size=256,
     silently poisoning downstream aggregates.
     """
     from raft_tpu.parallel import resilience
-    from raft_tpu.utils.devices import enable_compile_cache
 
-    enable_compile_cache()
-    if mesh is None:
-        mesh = resilience.resolve_mesh(make_mesh)
+    if not _routes_to_fabric(evaluate):
+        from raft_tpu.utils.devices import enable_compile_cache
 
-    def compute(chunk, mesh_):
-        ndev = mesh_.devices.size
-        pad = (-len(next(iter(chunk.values())))) % ndev
-        if pad:
-            chunk = {k: np.concatenate([v, np.repeat(v[-1:], pad, 0)])
-                     for k, v in chunk.items()}
-        return sweep_cases_full(evaluate, chunk, mesh=mesh_,
-                                out_keys=out_keys, shard_freq=shard_freq)
+        enable_compile_cache()
+        if mesh is None:
+            mesh = resilience.resolve_mesh(make_mesh)
 
     return resilience.run_checkpointed(
-        compute, cases, out_dir, shard_size, mesh, out_keys,
+        full_compute(evaluate, out_keys=out_keys, shard_freq=shard_freq),
+        cases, out_dir, shard_size, mesh, out_keys,
         on_shard=on_shard, max_retries=max_retries, backoff_s=backoff_s,
         quarantine_retry=quarantine_retry)
 
@@ -565,23 +685,15 @@ def run_sweep_checkpointed(evaluate, Hs, Tp, beta, out_dir, shard_size=256,
     from raft_tpu.parallel import resilience
     from raft_tpu.utils.devices import enable_compile_cache
 
-    enable_compile_cache()
-    if mesh is None:
-        mesh = resilience.resolve_mesh(make_mesh)
+    if not _routes_to_fabric(evaluate):
+        enable_compile_cache()
+        if mesh is None:
+            mesh = resilience.resolve_mesh(make_mesh)
     cases = {"Hs": np.asarray(Hs), "Tp": np.asarray(Tp),
              "beta": np.asarray(beta)}
 
-    def compute(chunk, mesh_):
-        ndev = mesh_.devices.size
-        h, t, b = chunk["Hs"], chunk["Tp"], chunk["beta"]
-        pad = (-len(h)) % ndev  # pad the tail shard to the device count
-        if pad:
-            h = np.concatenate([h, np.full(pad, h[-1])])
-            t = np.concatenate([t, np.full(pad, t[-1])])
-            b = np.concatenate([b, np.full(pad, b[-1])])
-        return sweep_cases(evaluate, h, t, b, mesh=mesh_, out_keys=out_keys)
-
     return resilience.run_checkpointed(
-        compute, cases, out_dir, shard_size, mesh, out_keys,
+        case_compute(evaluate, out_keys=out_keys),
+        cases, out_dir, shard_size, mesh, out_keys,
         on_shard=on_shard, max_retries=max_retries, backoff_s=backoff_s,
         quarantine_retry=quarantine_retry)

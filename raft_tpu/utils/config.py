@@ -246,6 +246,46 @@ _register(
     Flag("FAULTS", "raw", "",
          help="deterministic fault injection: comma list of "
               "kind:site[:count] specs (see raft_tpu.utils.faults)"),
+    # -- elastic sweep fabric (see raft_tpu.parallel.fabric and README
+    #    "Elastic sweep fabric")
+    Flag("FABRIC_WORKERS", "int", 0,
+         help="route checkpointed sweeps through N local fabric worker "
+              "subprocesses claiming shards from the lease ledger "
+              "(0/1 = serial in-process path; needs a fabric entry "
+              "spec on the evaluator — see README)"),
+    Flag("FABRIC_TTL_S", "float", 30.0,
+         help="shard lease time-to-live: a lease not renewed within "
+              "this window is expired and the shard is stealable "
+              "(a dead worker is just an expired lease)"),
+    Flag("FABRIC_STEAL_MULT", "float", 4.0,
+         help="straggler steal threshold: a lease older than this "
+              "multiple of the pooled shard_wall_s p95 is stealable "
+              "even while still being renewed"),
+    Flag("FABRIC_POLL_S", "float", 0.5,
+         help="fabric ledger poll period for idle workers and the "
+              "coordinator wait loop"),
+    Flag("FABRIC_FAULT_WORKER", "int", 0,
+         help="index of the ONE spawned worker that receives the "
+              "worker-targeted RAFT_TPU_FAULTS kinds (worker_kill, "
+              "lease_expire); other workers get them stripped so the "
+              "kill-a-worker test is deterministic"),
+    Flag("WORKER_ID", "raw", "",
+         help="fabric worker id stamped as 'worker' on every "
+              "structured-log record (set by the coordinator for "
+              "spawned workers; per-worker event streams stay "
+              "separable in one shared RAFT_TPU_LOG capture)"),
+    # -- multi-host distributed runtime (dryrun-tested on CPU; wired
+    #    into resilience.resolve_mesh for real pods)
+    Flag("DIST", "bool", False,
+         help="call jax.distributed.initialize before mesh "
+              "construction: the mesh spans every process's devices "
+              "(multi-host pmap/shard_map pods)"),
+    Flag("DIST_COORDINATOR", "str", "localhost:12765",
+         help="jax.distributed coordinator address host:port"),
+    Flag("DIST_PROCESS_ID", "int", 0,
+         help="this process's index in the distributed job"),
+    Flag("DIST_NUM_PROCESSES", "int", 1,
+         help="total process count in the distributed job"),
     Flag("PROFILE", "str", "",
          help="when set, the bench AND any checkpointed sweep capture a "
               "jax profiler trace into this directory; telemetry spans "
@@ -276,4 +316,14 @@ _register(
               "(internal, parent -> child)"),
     Flag("BENCH_BASE_HOST", "str", "",
          help="host fingerprint of the NumPy baseline (internal)"),
+    Flag("BENCH_FABRIC", "bool", True,
+         help="append the fabric scaling block (same sweep at 1/2/4 "
+              "workers) to the bench result when budget remains"),
+    Flag("BENCH_FABRIC_N", "int", 1024,
+         help="designs in the bench fabric scaling sweep"),
+    Flag("BENCH_FABRIC_SHARD", "int", 64,
+         help="shard size of the bench fabric scaling sweep"),
+    Flag("BENCH_FABRIC_WORKERS", "str", "1,2,4",
+         help="comma list of worker counts the bench fabric block "
+              "measures"),
 )

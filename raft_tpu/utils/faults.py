@@ -29,7 +29,21 @@ truncate   shard_write        shard file is truncated after the atomic
 nan        shard_result       first row of the computed shard is poisoned
                               with NaN
 unhealthy  backend_probe      ``probe_backend()`` reports the backend dead
+worker_kill worker_shard      fabric worker SIGKILLs itself right after
+                              claiming a shard lease (simulates a
+                              preempted/OOM-killed host mid-shard; the
+                              lease expires and the shard is stolen)
+lease_expire lease_renew      fabric worker silently stops renewing its
+                              leases (simulates a wedged-but-alive
+                              process; stragglers get stolen while the
+                              worker keeps computing)
 ========== ================== ==============================================
+
+The two worker-targeted kinds (``worker_kill``, ``lease_expire``) are
+forwarded by the fabric coordinator to exactly ONE spawned worker
+(index ``RAFT_TPU_FABRIC_FAULT_WORKER``, default 0) and stripped from
+the rest — every worker arming ``worker_kill:worker_shard:1`` from a
+shared environment would kill the whole fleet once each.
 
 Example::
 

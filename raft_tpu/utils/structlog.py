@@ -111,6 +111,12 @@ def log_event(event, **payload):
         return
     rec = {"t": round(time.perf_counter() - _T0, 6), "event": event,
            "pid": os.getpid(), "run_id": run_id()}
+    # fabric worker stamp: one shared RAFT_TPU_LOG capture holds every
+    # worker's stream; the per-record worker id keeps them separable
+    # (per-worker tables in `python -m raft_tpu.obs report`)
+    wid = config.raw("WORKER_ID")
+    if wid:
+        rec["worker"] = wid
     ctx = SPAN_CTX.get()
     if ctx is not None:
         rec["trace_id"], rec["span_id"] = ctx

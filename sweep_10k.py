@@ -15,6 +15,14 @@ Usage:
 
 Writes shard_NNNN.npz checkpoints plus SWEEP_10K.json with the
 throughput summary.  Re-running resumes from completed shards.
+
+Elastic fabric: ``RAFT_TPU_FABRIC_WORKERS=N python sweep_10k.py``
+runs the SAME sweep N-way parallel with zero further changes — the
+evaluator below carries a fabric entry spec
+(:func:`fabric_entry`), so the checkpointed runner routes shards
+through N worker subprocesses claiming leases from the shared ledger
+(:mod:`raft_tpu.parallel.fabric`); results, shards and manifest are
+bit-identical to the serial run.
 """
 
 import argparse
@@ -27,39 +35,16 @@ import numpy as np
 from raft_tpu.utils import config
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--n", type=int, default=10000)
-    ap.add_argument("--shard", type=int, default=512)
-    ap.add_argument("--out", default="_sweep10k")
-    ap.add_argument("--platform", default=config.get("BENCH_PLATFORM"))
-    args = ap.parse_args()
-
+def build_design_evaluator():
+    """Build the north-star per-design summary evaluator (12-case
+    operating table folded to compact statistics) at module scope so
+    both :func:`main` and the fabric workers' :func:`fabric_entry`
+    construct the IDENTICAL traced program.  Returns
+    ``(model, evaluate_design)``."""
     import jax
-
-    # the shared funnel (raft_tpu.utils.devices.enable_compile_cache):
-    # repo-local XLA disk cache (threshold from RAFT_TPU_CACHE_MIN_
-    # COMPILE_S, default 0 so sub-10s CPU programs persist too), the
-    # recompile-sentinel telemetry, and the AOT program-bank counters —
-    # with RAFT_TPU_AOT=load a resumed/fresh run loads its sweep
-    # programs from the bank instead of re-tracing for half a minute
-    from raft_tpu.utils.devices import enable_compile_cache
-
-    enable_compile_cache(
-        cache_dir=os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "_jax_cache"),
-        platform=args.platform or None)
     import jax.numpy as jnp
 
     import bench
-    from raft_tpu.parallel import resilience
-    from raft_tpu.parallel.sweep import make_mesh, run_sweep_checkpointed_full
-
-    # resolve the mesh BEFORE the first jax computation: the health
-    # probe runs in a subprocess, and on a dead accelerator tunnel the
-    # CPU-platform pin only takes effect if no in-process backend has
-    # been initialized yet (bench.build() below is the first jnp touch)
-    mesh = (None if args.platform else resilience.resolve_mesh(make_mesh))
 
     model, evaluate = bench.build()       # geometry=True full evaluator
     dw = model.w[1] - model.w[0]
@@ -112,6 +97,61 @@ def main():
         # this wrapper's traced math lives OUTSIDE raft_tpu/ (the
         # bank's code fingerprint), so its source content joins the key
         aot_bank.file_fingerprint(os.path.abspath(__file__)))
+    # fabric entry spec: lets RAFT_TPU_FABRIC_WORKERS=N route this
+    # sweep through worker subprocesses that rebuild the evaluator via
+    # fabric_entry below (raft_tpu.parallel.fabric)
+    evaluate_design._raft_fabric_entry = {
+        "entry": "sweep_10k:fabric_entry", "kwargs": {}}
+    return model, evaluate_design
+
+
+def fabric_entry(out_keys=("max_offset", "max_pitch_deg", "surge_std",
+                           "pitch_std", "X0", "drag_resid", "status"),
+                 shard_freq=False, **_):
+    """Fabric worker entry: rebuild the design evaluator in the worker
+    process and return its shard compute (the same
+    :func:`raft_tpu.parallel.sweep.full_compute` path the serial
+    checkpointed runner dispatches through)."""
+    from raft_tpu.parallel.sweep import full_compute
+
+    _, evaluate_design = build_design_evaluator()
+    return full_compute(evaluate_design, out_keys=tuple(out_keys),
+                        shard_freq=shard_freq)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=10000)
+    ap.add_argument("--shard", type=int, default=512)
+    ap.add_argument("--out", default="_sweep10k")
+    ap.add_argument("--platform", default=config.get("BENCH_PLATFORM"))
+    args = ap.parse_args()
+
+    import jax
+
+    # the shared funnel (raft_tpu.utils.devices.enable_compile_cache):
+    # repo-local XLA disk cache (threshold from RAFT_TPU_CACHE_MIN_
+    # COMPILE_S, default 0 so sub-10s CPU programs persist too), the
+    # recompile-sentinel telemetry, and the AOT program-bank counters —
+    # with RAFT_TPU_AOT=load a resumed/fresh run loads its sweep
+    # programs from the bank instead of re-tracing for half a minute
+    from raft_tpu.utils.devices import enable_compile_cache
+
+    enable_compile_cache(
+        cache_dir=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "_jax_cache"),
+        platform=args.platform or None)
+    import bench
+    from raft_tpu.parallel import resilience
+    from raft_tpu.parallel.sweep import make_mesh, run_sweep_checkpointed_full
+
+    # resolve the mesh BEFORE the first jax computation: the health
+    # probe runs in a subprocess, and on a dead accelerator tunnel the
+    # CPU-platform pin only takes effect if no in-process backend has
+    # been initialized yet (bench.build() below is the first jnp touch)
+    mesh = (None if args.platform else resilience.resolve_mesh(make_mesh))
+
+    model, evaluate_design = build_design_evaluator()
 
     g4 = bench.sample_geometry(args.n, seed=11).astype(np.float32)
     if mesh is None:

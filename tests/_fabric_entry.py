@@ -1,0 +1,75 @@
+"""Fabric entry callables for tests/test_fabric.py worker subprocesses.
+
+Not a pytest module (underscore name): fabric workers import these by
+FILE PATH (``.../tests/_fabric_entry.py:toy_entry``), so the toy
+evaluators need no installable package.  The computes are cheap,
+deterministic pure functions of the case arrays — identical results on
+any mesh / any worker count, which is exactly what the bit-identical
+acceptance tests compare against the serial runner.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _toy_full(c):
+    """Same math as tests/test_resilience.py::toy_full."""
+    return {"PSD": jnp.stack([c["Hs"], c["Tp"], c["Hs"] * c["Tp"]]),
+            "X0": c["Hs"] - c["Tp"]}
+
+
+def toy_entry(out_keys=("PSD", "X0"), **_):
+    """Plain toy entry: dict-case evaluator via the shared
+    full_compute path."""
+    from raft_tpu.parallel.sweep import full_compute
+
+    return full_compute(_toy_full, out_keys=tuple(out_keys))
+
+
+def slow_toy_entry(out_keys=("PSD", "X0"), delay_s=0.3, **_):
+    """Toy entry whose every shard takes ``delay_s`` of wall time —
+    long enough that several workers demonstrably interleave (and that
+    a mid-sweep joiner finds shards left to claim).  The sleep wraps
+    the shard compute on the HOST (a sleep inside the traced evaluator
+    would only run at trace time)."""
+    from raft_tpu.parallel.sweep import full_compute
+
+    inner = full_compute(_toy_full, out_keys=tuple(out_keys))
+
+    def compute(chunk, mesh):
+        time.sleep(float(delay_s))
+        return inner(chunk, mesh)
+
+    return compute
+
+
+def toy_with_cases_entry(n=12, out_keys=("PSD", "X0"), **_):
+    """Entry that also supplies its case batch (the pure-CLI path)."""
+    rng = np.random.default_rng(3)
+    return {
+        "compute": toy_entry(out_keys=out_keys),
+        "cases": {"Hs": 2.0 + 6.0 * rng.random(int(n)),
+                  "Tp": 8.0 + 8.0 * rng.random(int(n))},
+    }
+
+
+def not_an_entry(**_):
+    """Returns neither a compute callable nor a compute dict —
+    resolve_entry must reject it loudly."""
+    return {"nope": 1}
+
+
+def stamped_toy_evaluator():
+    """A toy evaluator carrying the fabric entry stamp — what a real
+    evaluator factory does so RAFT_TPU_FABRIC_WORKERS can route the
+    checkpointed drivers through the fabric."""
+    here = __file__
+
+    def evaluate(c):
+        return _toy_full(c)
+
+    evaluate._raft_fabric_entry = {"entry": f"{here}:toy_entry",
+                                   "kwargs": {}}
+    return evaluate

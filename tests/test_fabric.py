@@ -1,0 +1,428 @@
+"""Elastic sweep-fabric tests (:mod:`raft_tpu.parallel.fabric`).
+
+Ledger mechanics (claim exclusivity, expiry, stealing, pooled
+straggler thresholds) are unit-tested in-process; the acceptance
+scenarios — 2-worker sweep bit-identical to serial, kill-a-worker
+(SIGKILL mid-shard -> lease expires -> shard stolen -> sweep completes
+with no duplicate/missing rows), mid-sweep worker join — run REAL
+worker subprocesses against toy entries in tests/_fabric_entry.py.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.obs import metrics
+from raft_tpu.parallel import fabric, resilience
+from raft_tpu.parallel.sweep import (
+    ensure_distributed, make_mesh, run_sweep_checkpointed_full)
+from raft_tpu.utils import faults
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _fabric_entry  # noqa: E402
+
+ENTRY_FILE = os.path.abspath(_fabric_entry.__file__)
+
+
+def _cases(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return dict(Hs=2.0 + 6.0 * rng.random(n), Tp=8.0 + 8.0 * rng.random(n))
+
+
+def _events(path, name=None):
+    with open(path) as f:
+        evs = [json.loads(line) for line in f if line.strip()]
+    return [e for e in evs if name is None or e["event"] == name]
+
+
+@pytest.fixture
+def log_path(tmp_path, monkeypatch):
+    p = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", p)
+    return p
+
+
+@pytest.fixture
+def fabric_env(monkeypatch):
+    """Worker subprocesses must land on CPU with a short lease TTL and
+    a snappy poll, whatever environment pytest itself runs under."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_TTL_S", "2.0")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_POLL_S", "0.1")
+
+
+MESH = None
+
+
+def mesh2():
+    global MESH
+    if MESH is None:
+        MESH = make_mesh(2)
+    return MESH
+
+
+# ------------------------------------------------------------------ ledger
+
+
+def test_claim_is_exclusive_release_reopens(tmp_path):
+    led_a = fabric.Ledger(str(tmp_path), 4, worker_id="a")
+    led_b = fabric.Ledger(str(tmp_path), 4, worker_id="b")
+    assert led_a.claim(0)
+    assert not led_b.claim(0)          # O_EXCL: one claimant wins
+    rec, _ = led_b.read_lease(0)
+    assert rec["worker"] == "a" and rec["attempt"] == 1
+    assert led_b.claim(1)              # other shards stay claimable
+    led_b.release(0)                   # not b's lease: must be a no-op
+    assert led_a.read_lease(0)[0]["worker"] == "a"
+    led_a.release(0)
+    assert led_a.read_lease(0) == (None, None)
+    assert led_b.claim(0)              # released -> claimable again
+
+
+def test_expired_lease_is_stolen_exactly_once(tmp_path, log_path,
+                                              monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_FABRIC_TTL_S", "0.2")
+    led_a = fabric.Ledger(str(tmp_path), 2, worker_id="a")
+    led_b = fabric.Ledger(str(tmp_path), 2, worker_id="b")
+    led_c = fabric.Ledger(str(tmp_path), 2, worker_id="c")
+    assert led_a.claim(0)
+    assert led_b.stealable(0)[0] is None    # fresh lease: not stealable
+    time.sleep(0.3)
+    reason, age, holder, attempt = led_b.stealable(0)
+    assert reason == "expired" and holder == "a" and attempt == 1
+    # renewal refreshes the clock
+    assert led_a.renew(0)
+    assert led_b.stealable(0)[0] is None
+    time.sleep(0.3)
+    reason, age, holder, attempt = led_b.stealable(0)
+    assert reason == "expired"
+    # exactly one stealer wins the rename
+    won_b = led_b.steal(0, reason, age, holder)
+    won_c = led_c.steal(0, reason, age, holder)
+    assert won_b and not won_c
+    assert led_c.claim(0, attempt=attempt + 1)
+    assert led_c.read_lease(0)[0]["attempt"] == 2
+    # the loser's renew must now fail (lease is c's)
+    assert not led_a.renew(0)
+    evs = _events(log_path, "shard_steal")
+    assert len(evs) == 1 and evs[0]["from_worker"] == "a" \
+        and evs[0]["reason"] == "expired"
+
+
+def test_holder_stale_status_file_makes_lease_stealable(tmp_path,
+                                                        monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_FABRIC_TTL_S", "0.3")
+    led_a = fabric.Ledger(str(tmp_path), 2, worker_id="a")
+    led_b = fabric.Ledger(str(tmp_path), 2, worker_id="b")
+    assert led_a.claim(0)
+    led_a.write_worker_status("running", held=[0])
+    old = time.time() - 10.0
+    os.utime(fabric._worker_path(str(tmp_path), "a"), (old, old))
+    # lease still fresh (just claimed) but the holder's heartbeat file
+    # went stale -> stealable without waiting out the lease TTL
+    reason, _, holder, _ = led_b.stealable(0)
+    assert reason == "holder_stale" and holder == "a"
+
+
+def test_straggler_steal_uses_pooled_wall_p95(tmp_path, monkeypatch):
+    # drop the process registry: earlier suite sweeps already observed
+    # shard_wall_s, which would pre-arm the straggler threshold
+    metrics.reset()
+    monkeypatch.setenv("RAFT_TPU_FABRIC_TTL_S", "60")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_STEAL_MULT", "4.0")
+    led_a = fabric.Ledger(str(tmp_path), 2, worker_id="a")
+    led_b = fabric.Ledger(str(tmp_path), 2, worker_id="b")
+    assert led_a.claim(0)
+    led_a.write_worker_status("running", held=[0])  # fresh heartbeat
+    # backdate the claim so its age dwarfs the typical shard wall
+    path = fabric._lease_path(str(tmp_path), 0)
+    with open(path) as f:
+        rec = json.load(f)
+    rec["claimed_t"] = time.time() - 5.0
+    rec["renewed_t"] = time.time()          # still renewing: alive
+    with open(path, "w") as f:
+        json.dump(rec, f)
+    # below MIN_WALL_SAMPLES pooled observations: no straggler verdict
+    assert led_b.stealable(0)[0] is None
+    h = metrics.Histogram("shard_wall_s")
+    for _ in range(8):
+        h.observe(0.05)                      # typical shard: 50 ms
+    led_b_state = h.state()
+    with open(fabric._worker_path(str(tmp_path), "b"), "w") as f:
+        json.dump({"worker": "b", "shard_wall_s": led_b_state}, f)
+    reason, age, holder, _ = led_b.stealable(0)
+    assert reason == "straggler" and holder == "a" and age > 4.0
+
+
+def test_histogram_state_roundtrip_and_merge():
+    a = metrics.Histogram("a")
+    b = metrics.Histogram("b")
+    for v in (0.1, 0.2, 0.3):
+        a.observe(v)
+    for v in (10.0, 20.0):
+        b.observe(v)
+    pooled = metrics.merge_states([a.state(), b.state()])
+    assert pooled.count == 5
+    assert pooled.min == pytest.approx(0.1) and pooled.max == 20.0
+    assert pooled.sum == pytest.approx(30.6)
+    assert pooled.percentile(0.95) >= 10.0
+    # garbled states are ignored, not fatal
+    pooled.merge_state({"count": "nan?"})
+    pooled.merge_state(None)
+    assert pooled.count == 5
+
+
+# ------------------------------------------------------------- entry specs
+
+
+def test_resolve_entry_module_and_file_forms():
+    res = fabric.resolve_entry(f"{ENTRY_FILE}:toy_with_cases_entry",
+                               {"n": 6})
+    assert callable(res["compute"]) and len(res["cases"]["Hs"]) == 6
+    res2 = fabric.resolve_entry(f"{ENTRY_FILE}:toy_entry")
+    assert callable(res2["compute"])
+    with pytest.raises(ValueError, match="module:callable"):
+        fabric.resolve_entry("no_colon_here")
+    with pytest.raises(ValueError, match="compute"):
+        fabric.resolve_entry(f"{ENTRY_FILE}:not_an_entry")
+
+
+def test_distributed_dryrun_config(monkeypatch, log_path):
+    assert ensure_distributed(dryrun=True) is None   # off by default
+    monkeypatch.setenv("RAFT_TPU_DIST", "1")
+    monkeypatch.setenv("RAFT_TPU_DIST_COORDINATOR", "10.0.0.1:8476")
+    monkeypatch.setenv("RAFT_TPU_DIST_NUM_PROCESSES", "4")
+    monkeypatch.setenv("RAFT_TPU_DIST_PROCESS_ID", "2")
+    cfg = ensure_distributed(dryrun=True)
+    assert cfg == {"coordinator": "10.0.0.1:8476", "process_id": 2,
+                   "num_processes": 4}
+    (ev,) = _events(log_path, "distributed_init")
+    assert ev["dryrun"] is True and ev["num_processes"] == 4
+    monkeypatch.setenv("RAFT_TPU_DIST_PROCESS_ID", "4")
+    with pytest.raises(ValueError, match="out of range"):
+        ensure_distributed(dryrun=True)
+    monkeypatch.setenv("RAFT_TPU_DIST_PROCESS_ID", "0")
+    monkeypatch.setenv("RAFT_TPU_DIST_COORDINATOR", "noport")
+    with pytest.raises(ValueError, match="host:port"):
+        ensure_distributed(dryrun=True)
+
+
+def test_lease_expire_fault_silences_renewer(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_FABRIC_TTL_S", "0.3")
+    led = fabric.Ledger(str(tmp_path), 1, worker_id="a")
+    led.write_worker_status("running")
+    assert led.claim(0)
+    silenced = [False]
+    renewer = fabric._Renewer(led, 0, silenced)
+    with faults.inject("lease_expire:lease_renew:1"):
+        renewer.start()
+        time.sleep(0.8)
+        renewer.stop()
+    assert silenced[0]
+    # renewals stopped: the lease aged past its TTL while "held"
+    reason, _, _, _ = fabric.Ledger(str(tmp_path), 1,
+                                    worker_id="b").stealable(0)
+    assert reason in ("expired", "holder_stale")
+
+
+# ------------------------------------------------- subprocess acceptance
+
+
+def test_two_worker_sweep_bit_identical_to_serial(tmp_path, log_path,
+                                                  fabric_env):
+    cases = _cases(24, seed=1)
+    serial = run_sweep_checkpointed_full(
+        _fabric_entry._toy_full, cases, str(tmp_path / "serial"),
+        shard_size=4, mesh=mesh2())
+
+    out_dir = str(tmp_path / "fab")
+    out = fabric.run_fabric(
+        out_dir, workers=2, entry=f"{ENTRY_FILE}:slow_toy_entry",
+        entry_kwargs={"delay_s": 0.25}, cases=cases,
+        out_keys=("PSD", "X0"), shard_size=4,
+        worker_env={"RAFT_TPU_HEARTBEAT_S": "0.2"})
+    for k in serial:
+        assert np.array_equal(np.asarray(serial[k]), out[k]), k
+
+    # both workers actually participated (slow shards interleave them)
+    claimants = {e["worker"] for e in _events(log_path, "shard_claim")}
+    assert claimants == {"w0", "w1"}
+    starts = _events(log_path, "fabric_worker_start")
+    assert {e["worker"] for e in starts} == {"w0", "w1"}
+    # worker cold-start provenance is reported per worker (AOT off in
+    # this toy run: nothing loaded, nothing banked)
+    assert all("programs_loaded" in e and "programs_compiled" in e
+               for e in starts)
+    # worker heartbeats carry the worker id and its held leases
+    beats = [e for e in _events(log_path, "heartbeat")
+             if e.get("worker_id")]
+    assert beats and all(isinstance(e.get("leases"), list) for e in beats)
+    assert any(e["leases"] for e in beats)
+    # the manifest records every shard done with its computing worker
+    with open(os.path.join(out_dir, "manifest.json")) as f:
+        man = json.load(f)
+    assert all(man["shards"][str(s)]["status"] == "done" for s in range(6))
+    assert {man["shards"][str(s)]["worker"] for s in range(6)} \
+        <= {"w0", "w1"}
+    assert man["metrics"]["counters"].get("shards_done") == 6
+    # per-worker table renders from the shared capture
+    from raft_tpu.obs.report import render_report
+
+    txt = render_report(_events(log_path))
+    assert "fabric workers" in txt and "w0" in txt and "w1" in txt
+
+
+def test_kill_a_worker_completes_bit_identical(tmp_path, log_path,
+                                               fabric_env, monkeypatch):
+    """The acceptance scenario: SIGKILL one worker mid-shard -> its
+    lease expires -> the shard is stolen -> the sweep completes with
+    results bit-identical to a fault-free serial run (no duplicate or
+    missing rows), manifest consistent."""
+    cases = _cases(24, seed=2)
+    serial = run_sweep_checkpointed_full(
+        _fabric_entry._toy_full, cases, str(tmp_path / "serial"),
+        shard_size=4, mesh=mesh2())
+
+    # worker_kill goes to worker index RAFT_TPU_FABRIC_FAULT_WORKER
+    # (default 0) ONLY; w1 survives and steals
+    monkeypatch.setenv("RAFT_TPU_FAULTS", "worker_kill:worker_shard:1")
+    out_dir = str(tmp_path / "fab")
+    out = fabric.run_fabric(
+        out_dir, workers=2, entry=f"{ENTRY_FILE}:slow_toy_entry",
+        entry_kwargs={"delay_s": 0.25}, cases=cases,
+        out_keys=("PSD", "X0"), shard_size=4)
+    for k in serial:
+        assert np.array_equal(np.asarray(serial[k]), out[k]), k
+    assert len(out["X0"]) == 24                     # no dup/missing rows
+
+    steals = _events(log_path, "shard_steal")
+    # whichever rule notices the dead worker first wins: TTL expiry, or
+    # the straggler threshold once enough shard walls pooled (the
+    # survivor's fast shards can arm p95 * FABRIC_STEAL_MULT below the
+    # 2s test TTL)
+    assert steals and steals[0]["from_worker"] == "w0" \
+        and steals[0]["reason"] in ("expired", "straggler", "holder_stale")
+    exits = {e["worker"]: e["returncode"]
+             for e in _events(log_path, "fabric_worker_exit")}
+    assert exits["w0"] != 0 and exits["w1"] == 0    # SIGKILL really hit
+    with open(os.path.join(out_dir, "manifest.json")) as f:
+        man = json.load(f)
+    assert all(man["shards"][str(s)]["status"] == "done"
+               for s in range(6))
+    stolen = steals[0]["shard"]
+    assert man["shards"][str(stolen)]["worker"] == "w1"
+    assert man["shards"][str(stolen)]["attempt"] == 2
+
+
+def test_midsweep_join_picks_up_remaining_shards(tmp_path, log_path,
+                                                 fabric_env):
+    cases = _cases(24, seed=3)
+    out_dir = str(tmp_path / "fab")
+    fabric.init_sweep(out_dir, f"{ENTRY_FILE}:slow_toy_entry", cases,
+                      ("PSD", "X0"), 4, entry_kwargs={"delay_s": 0.3})
+    p0, w0 = fabric.spawn_worker(out_dir, index=0)
+    # join mid-sweep: by the time a fresh process is up (~seconds of
+    # jax import) the first worker is partway through the 6 shards
+    time.sleep(1.0)
+    p1, w1 = fabric.spawn_worker(out_dir, index=1)
+    assert p0.wait(timeout=120) == 0 and p1.wait(timeout=120) == 0
+
+    out = fabric.assemble(out_dir)
+    np.testing.assert_array_equal(out["X0"], cases["Hs"] - cases["Tp"])
+    ledger = fabric.Ledger(out_dir, 6)
+    by_worker = {}
+    for s in range(6):
+        rec = ledger.read_done(s)
+        by_worker.setdefault(rec["worker"], []).append(s)
+    assert set(by_worker) == {"w0", "w1"}           # the joiner got work
+    starts = _events(log_path, "fabric_worker_start")
+    assert {e["worker"] for e in starts} == {"w0", "w1"}
+
+
+def test_fabric_workers_env_routes_checkpointed_sweep(tmp_path, log_path,
+                                                      fabric_env,
+                                                      monkeypatch):
+    """RAFT_TPU_FABRIC_WORKERS=2 + a stamped evaluator: the standard
+    checkpointed driver runs N-way with zero caller changes."""
+    cases = _cases(12, seed=4)
+    serial = run_sweep_checkpointed_full(
+        _fabric_entry._toy_full, cases, str(tmp_path / "serial"),
+        shard_size=4, mesh=mesh2())
+    monkeypatch.setenv("RAFT_TPU_FABRIC_WORKERS", "2")
+    out = run_sweep_checkpointed_full(
+        _fabric_entry.stamped_toy_evaluator(), cases,
+        str(tmp_path / "fab"), shard_size=4, mesh=mesh2())
+    for k in serial:
+        assert np.array_equal(np.asarray(serial[k]), np.asarray(out[k])), k
+    assert _events(log_path, "fabric_worker_spawn")
+
+    # an unstamped closure cannot ship to workers: loud event, serial
+    # fallback, same results
+    out2 = run_sweep_checkpointed_full(
+        _fabric_entry._toy_full, cases, str(tmp_path / "fallback"),
+        shard_size=4, mesh=mesh2())
+    for k in serial:
+        assert np.array_equal(np.asarray(serial[k]), np.asarray(out2[k]))
+    assert _events(log_path, "fabric_unavailable")
+
+
+def test_all_workers_dead_raises_fabric_error(tmp_path, fabric_env,
+                                              monkeypatch):
+    cases = _cases(8, seed=5)
+    # kill-fault forwarded to BOTH workers via FABRIC_FAULT_WORKER
+    # pinning each index in turn is overkill — simply give each worker
+    # enough kill shots by targeting index 0 with a 1-worker fleet
+    monkeypatch.setenv("RAFT_TPU_FAULTS", "worker_kill:worker_shard:1")
+    with pytest.raises(fabric.FabricError, match="workers exited"):
+        fabric.run_fabric(
+            str(tmp_path / "fab"), workers=1,
+            entry=f"{ENTRY_FILE}:toy_entry", cases=cases,
+            out_keys=("PSD", "X0"), shard_size=4)
+
+
+def test_resume_after_serial_run_skips_done_shards(tmp_path, log_path,
+                                                   fabric_env):
+    """A fabric run over an out_dir holding valid serial shards resumes
+    them (manifest-validated) instead of recomputing."""
+    cases = _cases(8, seed=6)
+    out_dir = str(tmp_path / "fab")
+    serial = run_sweep_checkpointed_full(
+        _fabric_entry._toy_full, cases, out_dir, shard_size=4,
+        mesh=mesh2())
+    out = fabric.run_fabric(
+        out_dir, workers=1, entry=f"{ENTRY_FILE}:toy_entry",
+        cases=cases, out_keys=("PSD", "X0"), shard_size=4)
+    for k in serial:
+        assert np.array_equal(np.asarray(serial[k]), out[k])
+    resumes = _events(log_path, "shard_resume")
+    assert sorted(e["shard"] for e in resumes) == [0, 1]
+    # changed inputs against the same ledger fail loudly in the worker
+    with pytest.raises(resilience.ManifestMismatchError):
+        fabric.init_sweep(out_dir, f"{ENTRY_FILE}:toy_entry",
+                          dict(cases, Hs=cases["Hs"] + 1.0),
+                          ("PSD", "X0"), 4)
+
+
+def test_fabric_resume_preserves_quarantine_audit(tmp_path, fabric_env):
+    """Adopting (resuming) shards must NOT re-judge quarantine.json:
+    a prior run's audit entries survive a fabric resume even though
+    the resumed done records carry no entries themselves."""
+    cases = _cases(8, seed=7)
+    out_dir = str(tmp_path / "fab")
+    with faults.inject("nan:shard_result:1"):
+        run_sweep_checkpointed_full(
+            _fabric_entry._toy_full, cases, out_dir, shard_size=4,
+            mesh=mesh2(), quarantine_retry=False)
+    before = resilience.load_quarantine(out_dir)
+    assert [e["index"] for e in before] == [0]
+
+    out = fabric.run_fabric(
+        out_dir, workers=1, entry=f"{ENTRY_FILE}:toy_entry",
+        cases=cases, out_keys=("PSD", "X0"), shard_size=4)
+    assert np.isnan(out["X0"][0])            # the bad row is still bad
+    after = resilience.load_quarantine(out_dir)
+    assert after == before                    # audit intact
