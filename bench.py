@@ -964,6 +964,9 @@ def run_mode(mode):
         # touches jax — compile caching happens inside the workers
         run_fabric_bench(t_start)
         return
+    if mode == "serve":
+        run_serve_bench(t_start)
+        return
     _enable_compile_cache()
     from raft_tpu.obs.heartbeat import maybe_heartbeat
 
@@ -1120,6 +1123,290 @@ def run_fabric_bench(t_start=None):
         block["note"] = note
     print(json.dumps({"fabric": block}))
     return block
+
+
+def serve_bench_pool(n, seed=23):
+    """The load test's case pool: ``n`` distinct (Hs, Tp, beta) corners
+    the synthetic clients draw from with repetition — duplicate corners
+    are the point (they exercise the result cache and the in-flight
+    coalescer, like real sweep/optimizer traffic)."""
+    rng = np.random.default_rng(seed)
+    return [(round(h, 3), round(t, 3), round(b, 3)) for h, t, b in zip(
+        rng.uniform(2.0, 8.0, n), rng.uniform(6.0, 14.0, n),
+        rng.uniform(-0.5, 0.5, n))]
+
+
+def run_serve_bench(t_start=None):
+    """The evaluation-service load test (``RAFT_TPU_BENCH_MODE=serve``,
+    ROADMAP item 3 acceptance): warm the AOT bank with the ``serve``
+    kind, start a server subprocess under the STRICT serving config
+    (``RAFT_TPU_AOT=require`` + ``RAFT_TPU_COMPILE_BUDGET=0`` — any
+    real XLA compile after warmup raises inside the server), then hit
+    it with hundreds of concurrent synthetic clients.  Reports
+    p50/p95 latency, evals/s, batch occupancy and cache hit rate, plus
+    a per-request parity block against solo evaluator calls and a
+    SIGTERM drain check.  Runs under x64 end to end so the parity
+    gates are float64-meaningful (x64 is part of the bank key — the
+    warmup, the server and the solo oracle all pin it).
+
+    Prints one JSON result line; the harness persists it as
+    BENCH_r07.json."""
+    import shutil
+    import signal as _signal
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    t_start = t_start if t_start is not None else time.perf_counter()
+    n_clients = int(config.get("BENCH_SERVE_CLIENTS"))
+    n_reqs = int(config.get("BENCH_SERVE_REQS"))
+    pool = serve_bench_pool(int(config.get("BENCH_SERVE_POOL")))
+    design = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "raft_tpu", "designs", "spar_demo.yaml")
+    base = tempfile.mkdtemp(prefix="raft_serve_bench_")
+    aot_dir = os.path.join(base, "aot_bank")
+    cache_dir = os.path.join(base, "jax_cache")
+    metrics_path = os.path.join(base, "serve_metrics.prom")
+    common = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "RAFT_TPU_AOT_DIR": aot_dir,
+        "RAFT_TPU_CACHE_DIR": cache_dir,
+        "RAFT_TPU_SERVE_MAX_BATCH": "64",
+        "RAFT_TPU_SERVE_TICK_MS": "20",
+    }
+    block = {"workload": f"spar_demo single-case serving: {n_clients} "
+                         f"concurrent clients x {n_reqs} requests, "
+                         f"{len(pool)}-case pool",
+             "host_cores": os.cpu_count()}
+    proc = None
+    stderr_f = None
+    try:
+        # ---- 1. fill the bank: the serve warmup kind at the ladder
+        t0 = time.perf_counter()
+        p = subprocess.run(
+            [sys.executable, "-m", "raft_tpu.aot", "warmup",
+             "--kinds", "serve", "--design", design, "--x64"],
+            env=dict(os.environ, **common, RAFT_TPU_AOT="load"),
+            capture_output=True, text=True, timeout=1800)
+        if p.returncode != 0:
+            raise RuntimeError(f"serve warmup failed: "
+                               f"{(p.stderr or '')[-800:]}")
+        block["warmup_s"] = round(time.perf_counter() - t0, 2)
+        block["warmup_programs"] = sum(
+            1 for line in p.stdout.splitlines()
+            if line.startswith("warmup serve"))
+
+        # ---- 2. the server, strict mode: a compile-free cold start is
+        # enforced, not hoped for
+        stderr_f = open(os.path.join(base, "server_stderr.txt"), "w")
+        t0 = time.perf_counter()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "raft_tpu.serve",
+             "--designs", f"spar={design}", "--port", "0", "--x64"],
+            env=dict(os.environ, **common,
+                     RAFT_TPU_AOT="require", RAFT_TPU_AOT_MISS="error",
+                     RAFT_TPU_COMPILE_BUDGET="0",
+                     RAFT_TPU_METRICS=metrics_path),
+            stdout=subprocess.PIPE, stderr=stderr_f, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        port = None
+        for line in proc.stdout:
+            if "serving" in line and "http://" in line:
+                port = int(line.split("http://", 1)[1].split()[0]
+                           .rsplit(":", 1)[1])
+                break
+        if port is None:
+            raise RuntimeError("server never became ready (see "
+                               f"{base}/server_stderr.txt)")
+        block["cold_start_s"] = round(time.perf_counter() - t0, 2)
+
+        # ---- 3. the load: N concurrent keep-alive clients drawing
+        # duplicate corners from the shared pool
+        from raft_tpu.serve.client import ServeClient
+
+        latencies, codes = [], []
+        sample: dict[int, dict] = {}
+        lock = threading.Lock()
+
+        def client(ci):
+            rng = np.random.default_rng(1000 + ci)
+            c = ServeClient("127.0.0.1", port, client_id=f"bench{ci}",
+                            timeout=600)
+            try:
+                for _ in range(n_reqs):
+                    pi = int(rng.integers(len(pool)))
+                    h, t, b = pool[pi]
+                    t0 = time.perf_counter()
+                    code, body = c.evaluate("spar", h, t, b)
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        latencies.append(dt)
+                        codes.append(code)
+                        if code == 200 and pi not in sample:
+                            sample[pi] = body
+            finally:
+                c.close()
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        load_wall = time.perf_counter() - t0
+        if not latencies:
+            # every client died before recording a response (server
+            # crash mid-load): fail with the server's own words, not an
+            # IndexError — base/ is cleaned up in the finally
+            with open(os.path.join(base, "server_stderr.txt")) as f:
+                tail = f.read()[-1500:]
+            raise RuntimeError(
+                f"serve load phase recorded no responses; server stderr "
+                f"tail:\n{tail}")
+        lat = np.sort(np.asarray(latencies))
+        n_ok = sum(1 for c in codes if c == 200)
+        block["load"] = dict(
+            clients=n_clients, requests=len(codes), ok=n_ok,
+            non_200=sorted({c for c in codes if c != 200}),
+            wall_s=round(load_wall, 2),
+            evals_per_s=round(n_ok / load_wall, 2),
+            p50_ms=round(float(lat[len(lat) // 2]) * 1e3, 1),
+            p95_ms=round(float(lat[int(len(lat) * 0.95)]) * 1e3, 1),
+            max_ms=round(float(lat[-1]) * 1e3, 1),
+        )
+
+        # ---- 4. server-side provenance: 0 real compiles, occupancy,
+        # cache hit rate
+        c = ServeClient("127.0.0.1", port)
+        _, health = c.healthz()
+        occ = health.get("batch_occupancy") or {}
+        block["server"] = dict(
+            programs_loaded=health.get("aot_programs_loaded"),
+            programs_compiled=health.get("aot_programs_compiled"),
+            xla_real_compiles=health.get("xla_real_compiles"),
+            dispatches=health.get("serve_dispatches"),
+            rows_dispatched=health.get("serve_rows_dispatched"),
+            coalesced_requests=health.get("serve_coalesced"),
+            batch_occupancy_mean=occ.get("mean"),
+            batch_occupancy_p95=occ.get("p95"),
+            cache=health.get("cache"),
+        )
+        c.close()
+
+        # ---- 5. parity: the served rows against solo evaluator calls
+        # in THIS process, through the same warmed bank
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+        for k, v in common.items():
+            os.environ[k] = v
+        # parity must read the SAME warmed bank the server used
+        os.environ[config.env_name("AOT")] = "load"
+        _enable_compile_cache()
+        import raft_tpu
+        from raft_tpu import api
+        from raft_tpu.parallel.sweep import make_mesh
+        from raft_tpu.serve import engine
+
+        model = raft_tpu.Model(design)
+        entry = engine.DesignEntry("spar", model)
+        mesh = make_mesh(1)
+        solo_jit = jax.jit(api.make_case_evaluator(model))
+        checked = 0
+        status_equal = x0_bits = engine_bits = True
+        psd_delta = solo_delta = 0.0
+        for pi, body in sorted(sample.items())[:12]:
+            h, t, b = pool[pi]
+            got = {k: np.asarray(v) for k, v in body["outputs"].items()}
+            se = engine.dispatch([entry], [h], [t], [b], mesh=mesh,
+                                 padded=1)
+            so = solo_jit(h, t, b)
+            status_equal &= (int(np.asarray(so["status"]))
+                             == int(body["status"]))
+            x0_bits &= np.array_equal(got["X0"],
+                                      np.asarray(se["X0"][0]))
+            engine_bits &= all(np.array_equal(got[k],
+                                              np.asarray(se[k][0]))
+                               for k in ("PSD", "X0", "status"))
+            psd_delta = max(psd_delta, float(np.max(np.abs(
+                got["PSD"] - np.asarray(se["PSD"][0])))))
+            solo_delta = max(solo_delta, max(
+                float(np.max(np.abs(got[k] - np.asarray(so[k]))))
+                for k in ("PSD", "X0")))
+            checked += 1
+        block["parity"] = dict(
+            cases_checked=checked,
+            status_bit_equal=bool(status_equal),
+            x0_bit_identical_vs_solo_dispatch=bool(x0_bits),
+            all_keys_bit_identical_vs_solo_dispatch=bool(engine_bits),
+            max_abs_delta_vs_solo_dispatch=psd_delta,
+            max_abs_delta_vs_solo_case_evaluator=solo_delta,
+        )
+
+        # ---- 6. SIGTERM drain under fire: every accepted request must
+        # get its response
+        drain_codes, drain_errors = [], []
+
+        def drain_client(ci):
+            dc = ServeClient("127.0.0.1", port, client_id=f"drain{ci}",
+                             timeout=600)
+            try:
+                h, t, b = pool[ci % len(pool)]
+                code, body = dc.evaluate("spar", h, t, b + 0.001 * ci)
+                # a 200 without its outputs payload IS a dropped
+                # response; rejects (503) legitimately carry none
+                ok_payload = (code != 200) or (
+                    isinstance(body, dict) and "outputs" in body)
+                drain_codes.append((code, ok_payload))
+            except (ConnectionError, OSError):
+                drain_codes.append(("refused", True))
+            except Exception as e:  # noqa: BLE001
+                drain_errors.append(repr(e))
+            finally:
+                dc.close()
+
+        threads = [threading.Thread(target=drain_client, args=(i,))
+                   for i in range(32)]
+        for th in threads:
+            th.start()
+        time.sleep(0.05)
+        proc.send_signal(_signal.SIGTERM)
+        for th in threads:
+            th.join(timeout=600)
+        rc = proc.wait(timeout=300)
+        accepted = [c for c, _ in drain_codes if c == 200]
+        bad_payloads = sum(1 for _, okp in drain_codes if not okp)
+        block["drain"] = dict(
+            burst=32, accepted=len(accepted),
+            rejected_or_refused=len(drain_codes) - len(accepted),
+            dropped_responses=len(drain_errors) + bad_payloads,
+            server_rc=rc,
+            metrics_flushed=os.path.exists(metrics_path),
+        )
+        ok = (rc == 0 and block["drain"]["dropped_responses"] == 0
+              and block["server"]["xla_real_compiles"] == 0
+              and status_equal and n_ok == len(codes))
+        result = {
+            "metric": f"serve evals/s (spar_demo, {n_clients} concurrent "
+                      f"clients, warmed AOT bank, x64)",
+            "value": block["load"]["evals_per_s"],
+            "unit": "evals/s",
+            "ok": bool(ok),
+            "breakdown": {"serve": block},
+        }
+        print(json.dumps(result))
+        return result
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        if stderr_f is not None:
+            stderr_f.close()
+        shutil.rmtree(base, ignore_errors=True)
 
 
 def _attach_fabric(line, budget, t_start):

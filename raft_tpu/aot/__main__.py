@@ -141,9 +141,12 @@ def main(argv=None):
                         "to the dp mesh-axis size)")
     p.add_argument("--kinds", default=",".join(
         ("cases", "full", "design")),
-        help="comma list of sweep kinds: cases,full,design,bucketed "
-             "(bucketed warms the shape-bucketed heterogeneous-design "
-             "programs over the bundled design trio)")
+        help="comma list of sweep kinds: cases,full,design,bucketed,"
+             "serve (bucketed warms the shape-bucketed heterogeneous-"
+             "design programs over the bundled design trio; serve "
+             "warms the evaluation service's single-case programs at "
+             "the RAFT_TPU_SERVE_MAX_BATCH batch ladder — --n is "
+             "ignored for it)")
     p.add_argument("--out-keys", default="PSD,X0,status",
                    help="out_keys of the warmed programs (include "
                         "'status' to warm the health fold)")

@@ -48,8 +48,14 @@ DEFAULT_KINDS = ("cases", "full", "design")
 #: ``bucketed`` warms the shape-bucketed heterogeneous-design programs
 #: (raft_tpu.structure.bucketing) over the BUNDLED design trio — one
 #: program per bucket signature, shared by every design in the bucket —
-#: so a fresh process answers a mixed-topology sweep with zero compiles
-ALL_KINDS = DEFAULT_KINDS + ("bucketed",)
+#: so a fresh process answers a mixed-topology sweep with zero compiles.
+#: ``serve`` warms the evaluation service's bucketed single-case
+#: programs at the batcher's padded batch-size ladder
+#: (dp,2*dp,..,RAFT_TPU_SERVE_MAX_BATCH — raft_tpu.serve.engine), so a
+#: fresh server answers its first request with zero compiles; ``--n``
+#: is ignored for this kind, set RAFT_TPU_SERVE_MAX_BATCH (and
+#: --out-keys/--x64) to EXACTLY what the server will run
+ALL_KINDS = DEFAULT_KINDS + ("bucketed", "serve")
 
 _DESIGNS_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "designs")
@@ -111,6 +117,7 @@ def warmup_model(design=None, sizes=(8,), kinds=DEFAULT_KINDS,
     # the single-design model only feeds the non-bucketed kinds; a
     # bucketed-only warmup must not pay its YAML load + host build
     evaluators = {}
+    model = None
     if set(kinds) - {"bucketed"}:
         if design is None:
             design = os.path.join(os.path.dirname(os.path.dirname(
@@ -207,4 +214,16 @@ def warmup_model(design=None, sizes=(8,), kinds=DEFAULT_KINDS,
                           n_buckets=len(by_sig), loaded=rep["loaded"],
                           compiled=rep["compiled"], wall_s=rep["wall_s"])
                 reports.append(rep)
+
+        if "serve" in kinds:
+            # the evaluation service's programs: the design's bucketed
+            # single-case evaluator at every padded batch size of the
+            # batcher's ladder — sizes come from RAFT_TPU_SERVE_MAX_BATCH
+            # (NOT --n), because the bank keys on input avals and the
+            # server dispatches exactly these ladder rungs
+            from raft_tpu.serve import engine as serve_engine
+
+            entry = serve_engine.DesignEntry("warmup", model)
+            reports += serve_engine.warm([entry], mesh=mesh,
+                                         out_keys=tuple(out_keys))
     return reports

@@ -199,6 +199,29 @@ def make_bucket_evaluator(sig):
     return get_bucket_evaluator(sig)
 
 
+def pack_for_serving(model):
+    """Request→packed-row adapter for the evaluation service
+    (:mod:`raft_tpu.serve`): resolve one built model into everything a
+    serving batcher needs to coalesce its requests into a shared
+    bucket program — ``(sig, packed, fingerprint)`` where ``sig`` is
+    the :func:`raft_tpu.structure.bucketing.bucket_signature` routing
+    key, ``packed`` the padded design pytree one request contributes as
+    a batch row (:func:`~raft_tpu.structure.bucketing.pack_design`),
+    and ``fingerprint`` the design-content hash that keys the service's
+    result cache (:mod:`raft_tpu.serve.cache`).
+
+    Raises :class:`raft_tpu.structure.bucketing.UnbucketableDesignError`
+    for designs outside the bucketed single-case chain (flexible
+    topologies, potential flow, farms) — the service rejects those at
+    registration, not mid-tick."""
+    from raft_tpu.aot.bank import content_fingerprint
+    from raft_tpu.structure import bucketing
+
+    sig = bucketing.bucket_signature(model)
+    packed = bucketing.pack_design(model, sig)
+    return sig, packed, content_fingerprint(model.design)
+
+
 def case_to_traced(case, nWaves=1):
     """Translate a parsed case-table row (reference key names,
     docs/usage.rst:167) into the traced-evaluator case dict consumed by

@@ -163,6 +163,37 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         "a sweep jit wrapper was built fresh (first call for this memo "
         "key; the next dispatch loads from the AOT bank or "
         "traces + compiles)"),
+    # ------------------------------------------------ evaluation service
+    "serve_start": (
+        ("host", "port", "designs", "tick_ms", "batch_sizes"),
+        "the evaluation service bound its socket (after design "
+        "registration and AOT warmup — raft_tpu.serve)"),
+    "serve_request": (
+        ("endpoint", "method", "code", "client", "wall_s", "cache_hit"),
+        "one HTTP request served (any endpoint; wall_s includes "
+        "queueing + batching + dispatch for /evaluate)"),
+    "serve_tick": (
+        ("rows", "unique", "n_groups", "dispatches", "wall_s"),
+        "one non-empty batcher tick: pending requests deduplicated, "
+        "grouped by bucket signature and dispatched"),
+    "serve_reject": (
+        ("reason", "client"),
+        "a request was refused at admission (reason: quota -> 429 | "
+        "queue_full -> 503)"),
+    "serve_escalate": (
+        ("status_before", "status_after", "resolved"),
+        "a SEVERE-flagged request opted into the f64_cpu re-solve; "
+        "only a healthy re-solve is adopted"),
+    "serve_error": (
+        ("error", "rows"),
+        "a serving dispatch raised; every coalesced requester got the "
+        "exception (HTTP 500)"),
+    "serve_drain": (
+        ("pending", "wall_s", "completed"),
+        "graceful drain: new work refused, pending ticks finished"),
+    "serve_stop": (
+        ("requests", "wall_s"),
+        "the service exited after draining and flushing metrics"),
     # ------------------------------------------------- AOT program bank
     "aot_load": (
         ("kind", "key", "bytes", "wall_s"),

@@ -223,6 +223,44 @@ def render_report(events, n_bad=0, source="<events>"):
                 f"{_fmt_s(_percentile(walls, 0.50))} "
                 f"{_fmt_s(_percentile(walls, 0.95))}")
 
+    # evaluation-service table: per-endpoint request/latency rows from
+    # serve_request events, batch occupancy from serve_tick events
+    endpoints = {}
+    for e in events:
+        if e["event"] != "serve_request":
+            continue
+        key = (str(e.get("endpoint") or "?"), int(e.get("code") or 0))
+        rec = endpoints.setdefault(key, {"walls": [], "hits": 0})
+        rec["walls"].append(e.get("wall_s") or 0.0)
+        if e.get("cache_hit"):
+            rec["hits"] += 1
+    ticks = [e for e in events if e["event"] == "serve_tick"]
+    if endpoints or ticks:
+        out.append("")
+        out.append("serve endpoints (endpoint / code / requests / "
+                   "cache hits / p50 / p95 / max)")
+        for (ep, code) in sorted(endpoints):
+            rec = endpoints[(ep, code)]
+            walls = rec["walls"]
+            out.append(
+                f"  {ep:24s} {code:4d} {len(walls):8d} {rec['hits']:8d} "
+                f"{_fmt_s(_percentile(walls, 0.50))} "
+                f"{_fmt_s(_percentile(walls, 0.95))} "
+                f"{_fmt_s(max(walls))}")
+        if ticks:
+            rows = [e.get("rows") or 0 for e in ticks]
+            uniq = [e.get("unique") or 0 for e in ticks]
+            disp = sum(e.get("dispatches") or 0 for e in ticks)
+            walls = [e.get("wall_s") or 0.0 for e in ticks]
+            # occupancy vs the padded program sizes lives in the
+            # serve_batch_occupancy histogram (metrics snapshot above);
+            # this line is the tick-level view of the same batching
+            out.append(
+                f"  ticks: {len(ticks)} ({sum(rows)} requests, "
+                f"{sum(uniq)} unique rows, {disp} dispatches; "
+                f"mean batch {sum(rows) / len(ticks):.1f}, "
+                f"tick p95 {_percentile(walls, 0.95):.3f}s)")
+
     counts = {}
     for e in events:
         counts[e["event"]] = counts.get(e["event"], 0) + 1

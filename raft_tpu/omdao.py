@@ -36,12 +36,23 @@ class DesignEvaluation:
     per evaluation instead of the orchestrated host path's seconds
     (VERDICT r4 Weak #7).  Arbitrary dotted-path overrides rebuild the
     model through the host path, which remains the oracle
-    (tests/test_omdao.py pins evaluator-vs-host metric parity)."""
+    (tests/test_omdao.py pins evaluator-vs-host metric parity).
 
-    def __init__(self, base_design, use_traced=True):
+    Duplicate iterates don't even dispatch: the traced per-case outputs
+    land in a content-addressed result cache
+    (:class:`raft_tpu.serve.cache.ResultCache`, keyed by design hash +
+    exact case bits), so an optimizer that revisits a corner — or a
+    line search that re-evaluates its anchor point — gets the stored
+    row back bit-identically instead of re-running the compiled
+    program.  Hit/miss/byte totals are exposed on :attr:`diag` (and as
+    ``omdao_cache_*`` metrics)."""
+
+    def __init__(self, base_design, use_traced=True, cache_mb=None):
         import os
 
+        from raft_tpu.serve.cache import ResultCache
         from raft_tpu.structure.schema import load_design
+        from raft_tpu.utils import config
 
         # remember the source directory so relative data paths (MoorDyn
         # files, WAMIT coefficients) keep resolving after the design is
@@ -51,6 +62,17 @@ class DesignEvaluation:
         self.base_design = load_design(base_design)
         self.use_traced = use_traced
         self._fast = None   # lazily: (model, jitted evaluate | None)
+        if cache_mb is None:
+            cache_mb = float(config.get("SERVE_CACHE_MB"))
+        self._cache = ResultCache(int(cache_mb * 1e6),
+                                  metrics_prefix="omdao_cache")
+        self._design_fp = None  # lazily: content hash of base_design
+
+    @property
+    def diag(self):
+        """Repeat-call diagnostics: result-cache counters (hits mean
+        "this iterate never re-dispatched the compiled evaluator")."""
+        return {f"cache_{k}": v for k, v in self._cache.stats().items()}
 
     # ---------------------------------------------------- traced path
 
@@ -86,6 +108,28 @@ class DesignEvaluation:
         self._fast = (model, evaluate)
         return self._fast
 
+    #: traced-evaluator outputs the metric chain consumes (and the
+    #: result cache therefore stores per case)
+    _CACHE_KEYS = ("X0", "Xi", "S", "zeta")
+
+    def _evaluate_cached(self, evaluate, traced_case):
+        """One traced-case dispatch through the result cache: duplicate
+        optimizer iterates (identical design + case bits) return the
+        stored row instead of re-running the compiled program."""
+        from raft_tpu.aot.bank import content_fingerprint
+        from raft_tpu.serve.cache import result_cache_key
+
+        if self._design_fp is None:
+            self._design_fp = content_fingerprint(self.base_design)
+        key = result_cache_key(self._design_fp, traced_case,
+                               self._CACHE_KEYS)
+        row = self._cache.get(key)
+        if row is None:
+            out = evaluate(traced_case)
+            row = {k: np.asarray(out[k]) for k in self._CACHE_KEYS}
+            self._cache.put(key, row)
+        return row
+
     def _compute_traced(self, model, evaluate):
         """Fill model.results['case_metrics'] from the traced evaluator:
         X0/Xi from the one-jit chain, channel statistics through the
@@ -96,7 +140,7 @@ class DesignEvaluation:
         model.results = {"case_metrics": {}, "mean_offsets": []}
         offs = model.dof_offsets
         for iCase, case in enumerate(model.cases):
-            out = evaluate(case_to_traced(case))
+            out = self._evaluate_cached(evaluate, case_to_traced(case))
             X0 = np.asarray(out["X0"])
             Xi = np.asarray(out["Xi"])
             model.results["case_metrics"][iCase] = {}

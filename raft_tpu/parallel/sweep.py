@@ -410,7 +410,6 @@ def sweep_heterogeneous(models, Hs, Tp, beta, mesh=None,
     out = {}
     for sig, idxs in groups.items():
         ev = bucketing.get_bucket_evaluator(sig)
-        leaf_names = packed_by_model[id(models[idxs[0]])][1].keys()
         if cap and len(idxs) > cap:
             chunks = [idxs[i:i + cap] for i in range(0, len(idxs), cap)]
         else:
@@ -420,10 +419,9 @@ def sweep_heterogeneous(models, Hs, Tp, beta, mesh=None,
             pad = (cap - rows) if len(chunks) > 1 else \
                 _autopad_rows(rows, mesh)
             take = chunk + [chunk[-1]] * pad
-            design = {
-                kk: np.stack([packed_by_model[id(models[i])][1][kk]
-                              for i in take])
-                for kk in leaf_names}
+            design = bucketing.stack_packed(
+                [packed_by_model[id(models[i])][1] for i in chunk],
+                rows + pad)
             case = dict(design=design, Hs=Hs[take], Tp=Tp[take],
                         beta=beta[take])
             in_sh = jax.tree_util.tree_map(lambda _: sharding, case)

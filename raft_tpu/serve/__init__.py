@@ -1,0 +1,58 @@
+"""Always-on evaluation service: continuous batching over the design
+axis (ROADMAP item 3).
+
+The "millions of users" north star needs a long-lived server, not a
+batch CLI.  This package is that server, assembled from the pillars
+the earlier PRs shipped:
+
+* the **AOT program bank** (:mod:`raft_tpu.aot`) answers a fresh
+  process in seconds — the service warms every program it will
+  dispatch BEFORE binding its socket;
+* **shape-bucketed batching** (:mod:`raft_tpu.structure.bucketing`)
+  lets arbitrary mixed-topology tenants share one compiled program —
+  the batcher groups pending requests by bucket signature, so one
+  dispatch serves many tenants;
+* the in-band int32 **status word** (:mod:`raft_tpu.utils.health`)
+  gives per-request error semantics (SEVERE bits → HTTP 422 with
+  ``describe()`` text, quarantine-style f64 re-solve opt-in per
+  request);
+* the **obs** metrics registry (:mod:`raft_tpu.obs.metrics`) is the
+  dashboard, served live over HTTP ``/metrics``.
+
+Layout (everything stdlib-only — asyncio, http-free hand parser, no
+new dependencies):
+
+``cache``    content-addressed LRU result cache with a byte budget
+             (design-pytree hash + case + out_keys → outputs; sweeps
+             and optimizer loops are full of duplicate corners)
+``quota``    per-client token buckets (429) and the bounded admission
+             queue semantics (503)
+``engine``   design registry + the packed-row dispatch through the
+             SAME ``_cached_jit``/AOT-bank funnel the sweeps use, at a
+             fixed ladder of padded batch sizes
+``batcher``  the socket-free continuous-batching core: submit →
+             pending queue → fixed-tick coalescing → per-request
+             fan-out (unit-testable without a server)
+``http``     the asyncio HTTP front end: ``POST /evaluate``,
+             ``GET /healthz``, ``GET /metrics``; graceful drain on
+             SIGTERM (finish in-flight ticks, refuse new work, flush
+             metrics)
+``client``   minimal stdlib client for load harnesses and tests
+
+Start a server::
+
+    python -m raft_tpu.aot warmup --kinds serve        # fill the bank
+    python -m raft_tpu.serve --designs spar=raft_tpu/designs/spar_demo.yaml \
+        --port 8787
+
+See the README "Evaluation service" section for the API schema, the
+tick/batching model and the flag/event tables.
+"""
+
+from __future__ import annotations
+
+from raft_tpu.serve.batcher import (Batcher, Draining, QueueFull,  # noqa: F401
+                                    QuotaExceeded, RejectError)
+from raft_tpu.serve.cache import ResultCache, result_cache_key  # noqa: F401
+from raft_tpu.serve.engine import DesignEntry, Registry  # noqa: F401
+from raft_tpu.serve.quota import TokenBucket  # noqa: F401

@@ -1,0 +1,129 @@
+"""CLI for the always-on evaluation service.
+
+    python -m raft_tpu.serve --designs spar=raft_tpu/designs/spar_demo.yaml \
+        [--designs semi=...] [--host 127.0.0.1] [--port 8787] \
+        [--out-keys PSD,X0,status] [--no-warm] [--platform cpu] [--x64]
+
+Startup order is the serving contract: build + pack every registered
+design, WARM every (bucket x batch-ladder) program through the AOT
+bank (:func:`raft_tpu.serve.engine.warm`), and only then bind the
+socket — a client can never reach a server that would trace on its
+request.  Under ``RAFT_TPU_AOT=require`` a cold bank fails here, at
+startup, not mid-request; fill it first with
+
+    python -m raft_tpu.aot warmup --kinds serve --design <yaml>
+
+``--port 0`` binds an ephemeral port; the ready line on stdout
+(``serving N design(s) on http://host:port ...``) reports the actual
+one (load harnesses parse it).  SIGTERM/SIGINT drains gracefully:
+in-flight requests finish, new work gets 503, metrics flush to
+``RAFT_TPU_METRICS`` when set.
+
+Tuning flags (see ``python -m raft_tpu.analysis flags``):
+``RAFT_TPU_SERVE_TICK_MS``, ``SERVE_MAX_BATCH``, ``SERVE_CACHE_MB``,
+``SERVE_QUEUE``, ``SERVE_QPS``, ``SERVE_BURST``, ``SERVE_TIMEOUT_S``,
+``SERVE_DRAIN_S``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+
+def _parse_designs(specs):
+    """``name=path`` (or bare path — name = file stem) from repeated /
+    comma-separated ``--designs`` values."""
+    out = {}
+    for spec in specs:
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" in item:
+                name, path = item.split("=", 1)
+            else:
+                name = os.path.splitext(os.path.basename(item))[0]
+                path = item
+            out[name.strip()] = path.strip()
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m raft_tpu.serve")
+    ap.add_argument("--designs", action="append", required=True,
+                    help="name=design.yaml (repeatable / comma list)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8787,
+                    help="0 binds an ephemeral port (see the ready line)")
+    ap.add_argument("--out-keys", default=",".join(
+        ("PSD", "X0", "status")),
+        help="out_keys this server dispatches (requests may ask for "
+             "subsets; 'status' is always included)")
+    ap.add_argument("--no-warm", action="store_true",
+                    help="skip the pre-bind warmup (first requests pay "
+                         "the trace/compile; testing only)")
+    ap.add_argument("--platform", default=None,
+                    help="jax platform pin (default: RAFT_TPU_CLI_PLATFORM)")
+    ap.add_argument("--x64", action="store_true",
+                    help="serve under jax_enable_x64 (warm the bank with "
+                         "--x64 too — x64 is part of the bank key)")
+    args = ap.parse_args(argv)
+
+    from raft_tpu.utils import config
+
+    platform = (args.platform if args.platform is not None
+                else config.get("CLI_PLATFORM"))
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if args.x64:
+        jax.config.update("jax_enable_x64", True)
+
+    from raft_tpu.serve import engine
+    from raft_tpu.serve.batcher import Batcher
+    from raft_tpu.serve.http import run_server
+    from raft_tpu.structure.bucketing import signature_fingerprint
+    from raft_tpu.utils.devices import enable_compile_cache
+
+    enable_compile_cache()
+    registry = engine.Registry()
+    designs = _parse_designs(args.designs)
+    if not designs:
+        print("no designs registered (--designs name=path)", file=sys.stderr)
+        return 2
+    for name, path in designs.items():
+        entry = registry.register(name, path)
+        print(f"registered {name}: bucket "
+              f"{signature_fingerprint(entry.sig)}", flush=True)
+
+    out_keys = tuple(k.strip() for k in args.out_keys.split(",") if k.strip())
+    batcher = Batcher(registry, out_keys=out_keys)
+    if not args.no_warm:
+        reports = engine.warm(
+            [registry.get(n) for n in registry.names()],
+            mesh=batcher.mesh, out_keys=batcher.out_keys,
+            sizes=batcher.sizes)
+        loaded = sum(r["loaded"] for r in reports)
+        compiled = sum(r["compiled"] for r in reports)
+        wall = sum(r["wall_s"] for r in reports)
+        print(f"warmup: {len(reports)} program(s) "
+              f"({loaded} bank-loaded, {compiled} compiled) in {wall:.1f}s",
+              flush=True)
+
+    def ready(server):
+        print(f"serving {len(registry)} design(s) on "
+              f"http://{server.host}:{server.port} "
+              f"(tick {batcher.tick_s * 1e3:.0f}ms, "
+              f"batch ladder {list(batcher.sizes)})", flush=True)
+
+    asyncio.run(run_server(batcher, host=args.host, port=args.port,
+                           ready=ready))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

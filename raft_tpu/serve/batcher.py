@@ -1,0 +1,375 @@
+"""The continuous-batching core of the evaluation service.
+
+Socket-free and unit-testable: requests go in through :meth:`Batcher.
+submit` (thread-safe, returns a ``concurrent.futures.Future``), pend in
+one bounded admission queue, and every ``RAFT_TPU_SERVE_TICK_MS`` the
+dispatcher coalesces the backlog — deduplicating identical in-flight
+cases, grouping the rest by bucket signature so MIXED-TOPOLOGY tenants
+share one compiled program, padding each group to the fixed batch
+ladder — into the bucketed evaluators, then fans the results back out
+per request.  This is inference-server-style continuous batching over
+the *design* axis: the batch dimension is "whichever tenants are
+waiting right now", not a precomputed sweep.
+
+Error semantics ride in-band: every row carries the int32 solver-health
+``status`` word (:mod:`raft_tpu.utils.health`); SEVERE bits surface in
+the result payload (the HTTP layer maps them to 422 with
+``describe()`` text), and a request may opt into a quarantine-style
+``f64_cpu`` re-solve (:func:`raft_tpu.serve.engine.escalate_row`) —
+only a HEALTHY re-solve is adopted, mirroring the sweep quarantine's
+adoption rule.
+
+Healthy rows land in the content-addressed result cache
+(:mod:`raft_tpu.serve.cache`); a submit-time hit resolves the future
+without ever queueing.  Backpressure: per-client token buckets raise
+:class:`QuotaExceeded` (→ 429), a full admission queue raises
+:class:`QueueFull` (→ 503), a draining service raises
+:class:`Draining` (→ 503).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from raft_tpu.obs import metrics
+from raft_tpu.serve import engine
+from raft_tpu.serve.cache import ResultCache, result_cache_key
+from raft_tpu.serve.quota import ClientQuotas
+from raft_tpu.utils import config, health
+from raft_tpu.utils.structlog import log_event
+
+
+class RejectError(RuntimeError):
+    """A request refused at admission (never queued)."""
+
+    reason = "rejected"
+    http_status = 503
+
+
+class QuotaExceeded(RejectError):
+    """Per-client token bucket dry — this client should slow down."""
+
+    reason = "quota"
+    http_status = 429
+
+    def __init__(self, retry_after_s=0.0):
+        super().__init__("client quota exceeded")
+        self.retry_after_s = retry_after_s
+
+
+class QueueFull(RejectError):
+    """Admission queue at its bound — every client should back off."""
+
+    reason = "queue_full"
+
+
+class Draining(RejectError):
+    """The service is draining (SIGTERM): in-flight work finishes, new
+    work is refused."""
+
+    reason = "draining"
+
+
+class _Request:
+    __slots__ = ("entry", "Hs", "Tp", "beta", "out_keys", "escalate_f64",
+                 "client", "future", "t_submit", "cache_key")
+
+    def __init__(self, entry, Hs, Tp, beta, out_keys, escalate_f64, client,
+                 cache_key):
+        self.entry = entry
+        self.Hs, self.Tp, self.beta = Hs, Tp, beta
+        self.out_keys = out_keys
+        self.escalate_f64 = bool(escalate_f64)
+        self.client = client
+        self.future = concurrent.futures.Future()
+        self.t_submit = time.perf_counter()
+        self.cache_key = cache_key
+
+
+class Batcher:
+    """Continuous batcher over a design :class:`~raft_tpu.serve.engine.
+    Registry`.
+
+    Construction resolves the mesh and the batch ladder but compiles
+    nothing; the first tick (or :func:`raft_tpu.serve.engine.warm`)
+    builds/loads the programs.  ``start()`` spawns the dispatcher
+    thread; tests drive :meth:`run_tick` directly instead.
+    """
+
+    def __init__(self, registry, out_keys=None, mesh=None, tick_ms=None,
+                 max_batch=None, cache=None, quotas=None, queue_bound=None):
+        from raft_tpu.parallel.sweep import make_mesh
+
+        self.registry = registry
+        # status is non-optional: per-request error semantics read it
+        self.out_keys = engine.normalize_out_keys(out_keys)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.sizes = engine.batch_ladder(self.mesh, max_batch)
+        self.tick_s = (float(config.get("SERVE_TICK_MS"))
+                       if tick_ms is None else float(tick_ms)) / 1e3
+        self.cache = cache if cache is not None else ResultCache(
+            int(float(config.get("SERVE_CACHE_MB")) * 1e6))
+        self.quotas = quotas if quotas is not None else ClientQuotas(
+            config.get("SERVE_QPS"), config.get("SERVE_BURST"))
+        self.queue_bound = (int(config.get("SERVE_QUEUE"))
+                            if queue_bound is None else int(queue_bound))
+        self._pending: deque[_Request] = deque()
+        self._cond = threading.Condition()
+        self._draining = False
+        self._stop = False
+        self._in_tick = False
+        self._thread = None
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, design, Hs, Tp, beta, out_keys=None, escalate_f64=False,
+               client=None):
+        """Admit one evaluation request; returns a Future resolving to
+        the result payload dict (``outputs``/``status``/``status_text``/
+        ``cache_hit``/``escalated``).  Raises :class:`KeyError` for an
+        unknown design name, :class:`ValueError` for out_keys outside
+        the served set, and a :class:`RejectError` subclass on
+        backpressure."""
+        entry = (design if isinstance(design, engine.DesignEntry)
+                 else self.registry.get(design))
+        if entry is None:
+            raise KeyError(f"unknown design {design!r}")
+        requested = tuple(out_keys) if out_keys else self.out_keys
+        extra_keys = set(requested) - set(self.out_keys)
+        if extra_keys:
+            raise ValueError(
+                f"out_keys {sorted(extra_keys)} not served (this server "
+                f"dispatches {list(self.out_keys)})")
+        if self._draining:
+            raise Draining("service is draining")
+        bucket = self.quotas.bucket(client)
+        if not bucket.acquire():
+            metrics.counter("serve_rejected_quota").inc()
+            log_event("serve_reject", reason="quota", client=str(client))
+            raise QuotaExceeded(retry_after_s=bucket.retry_after_s())
+        Hs, Tp, beta = float(Hs), float(Tp), float(beta)
+        metrics.counter("serve_requests").inc()
+        key = result_cache_key(
+            entry.fingerprint, {"Hs": Hs, "Tp": Tp, "beta": beta},
+            self.out_keys, extra=engine.flags_extra())
+        req = _Request(entry, Hs, Tp, beta, requested, escalate_f64, client,
+                       key)
+        row = self.cache.get(key)
+        if row is not None:
+            # only HEALTHY rows are cached, so an opt-in escalation
+            # never applies to a hit
+            self._resolve(req, row, cache_hit=True)
+            return req.future
+        with self._cond:
+            if self._draining:
+                bucket.refund()   # rejected work must not eat quota
+                raise Draining("service is draining")
+            if len(self._pending) >= self.queue_bound:
+                bucket.refund()
+                metrics.counter("serve_rejected_queue").inc()
+                log_event("serve_reject", reason="queue_full",
+                          client=str(client))
+                raise QueueFull(
+                    f"admission queue full ({self.queue_bound} pending)")
+            self._pending.append(req)
+            metrics.gauge("serve_pending").set(len(self._pending))
+            # deliberately NO notify: the dispatcher wakes on its tick
+            # cadence, and that sleep IS the coalescing window — waking
+            # it per submit would dispatch every lull-time request as a
+            # batch of one (only drain() wakes it out of cadence)
+        return req.future
+
+    # -------------------------------------------------------------- tick
+
+    def run_tick(self):
+        """Dispatch everything pending NOW (the dispatcher thread calls
+        this once per tick; tests call it directly).  Returns the
+        number of requests resolved."""
+        with self._cond:
+            batch = list(self._pending)
+            self._pending.clear()
+            metrics.gauge("serve_pending").set(0)
+            self._in_tick = True
+        if not batch:
+            with self._cond:
+                self._in_tick = False
+                self._cond.notify_all()
+            return 0
+        t0 = time.perf_counter()
+        # dedupe identical in-flight cases: one dispatched row fans out
+        # to every requester (sweeps and optimizer herds are full of
+        # duplicate corners that miss the cache only because they are
+        # simultaneous)
+        unique: dict[str, list[_Request]] = {}
+        for req in batch:
+            unique.setdefault(req.cache_key, []).append(req)
+        metrics.counter("serve_coalesced").inc(len(batch) - len(unique))
+        groups: dict = {}
+        for reqs in unique.values():
+            groups.setdefault(reqs[0].entry.sig, []).append(reqs)
+        n_dispatch = 0
+        deferred = []   # (reqs, row) needing an f64 escalation re-solve
+        for sig, reqlists in groups.items():
+            cap = self.sizes[-1]
+            for lo in range(0, len(reqlists), cap):
+                chunk = reqlists[lo:lo + cap]
+                firsts = [rl[0] for rl in chunk]
+                try:
+                    out = engine.dispatch(
+                        [r.entry for r in firsts],
+                        [r.Hs for r in firsts], [r.Tp for r in firsts],
+                        [r.beta for r in firsts],
+                        out_keys=self.out_keys, mesh=self.mesh,
+                        padded=engine.pick_padded(len(firsts), self.sizes))
+                    n_dispatch += 1
+                except Exception as e:  # noqa: BLE001 — fan the failure out
+                    log_event("serve_error", error=repr(e)[:300],
+                              rows=len(chunk))
+                    metrics.counter("serve_errors").inc()
+                    for rl in chunk:
+                        for req in rl:
+                            if not req.future.set_running_or_notify_cancel():
+                                continue
+                            req.future.set_exception(e)
+                    continue
+                for i, rl in enumerate(chunk):
+                    row = {k: out[k][i] for k in self.out_keys}
+                    if self._needs_escalation(rl, row):
+                        deferred.append((rl, row))
+                    else:
+                        self._finalize(rl, row)
+        # escalation re-solves run LAST (and still on this thread:
+        # _rung_flags mutates process-wide env, so a parallel escalation
+        # would leak f64 flags into a concurrent normal dispatch) —
+        # every non-escalating requester already has its result before
+        # anyone pays the solo re-solve, which on first use may
+        # trace+compile the unwarmed f64_cpu program.  The head-of-line
+        # cost that remains is the NEXT tick, documented tradeoff.
+        for rl, row in deferred:
+            self._finalize(rl, row)
+        wall = time.perf_counter() - t0
+        metrics.histogram("serve_tick_s").observe(wall)
+        log_event("serve_tick", rows=len(batch), unique=len(unique),
+                  n_groups=len(groups), dispatches=n_dispatch,
+                  wall_s=round(wall, 6))
+        with self._cond:
+            self._in_tick = False
+            self._cond.notify_all()
+        return len(batch)
+
+    @staticmethod
+    def _needs_escalation(reqs, row):
+        return (bool(health.any_bit(int(np.asarray(row["status"]))))
+                and any(r.escalate_f64 for r in reqs))
+
+    def _finalize(self, reqs, row):
+        """Fan one dispatched row out to its (deduplicated) requesters:
+        status checks, optional f64 escalation, cache insert."""
+        status = int(np.asarray(row["status"]))
+        severe = bool(health.any_bit(status))
+        esc_row, esc_info = None, None
+        if severe and any(r.escalate_f64 for r in reqs):
+            try:
+                retried, st2 = engine.escalate_row(
+                    reqs[0].entry, reqs[0].Hs, reqs[0].Tp, reqs[0].beta,
+                    out_keys=self.out_keys, mesh=self.mesh)
+            except Exception as e:  # noqa: BLE001 — keep the base row
+                esc_info = {"status_before": status, "status_after": None,
+                            "resolved": False, "error": repr(e)[:200]}
+            else:
+                resolved = not bool(health.any_bit(st2))
+                esc_info = {"status_before": status, "status_after": st2,
+                            "resolved": resolved}
+                if resolved:
+                    esc_row = retried
+                log_event("serve_escalate", status_before=status,
+                          status_after=st2, resolved=resolved)
+        if not severe:
+            self.cache.put(reqs[0].cache_key, row)
+        for req in reqs:
+            use_esc = esc_row is not None and req.escalate_f64
+            self._resolve(req, esc_row if use_esc else row, cache_hit=False,
+                          escalated=esc_info if req.escalate_f64 else None)
+
+    def _resolve(self, req, row, cache_hit, escalated=None):
+        status = int(np.asarray(row["status"]))
+        result = {
+            "outputs": {k: row[k] for k in req.out_keys},
+            "status": status,
+            "status_text": health.describe(status),
+            "severe": bool(health.any_bit(status)),
+            "cache_hit": bool(cache_hit),
+            "escalated": escalated,
+        }
+        if not req.future.set_running_or_notify_cancel():
+            return  # requester went away (client timeout/cancel)
+        metrics.histogram("serve_request_s").observe(
+            time.perf_counter() - req.t_submit)
+        req.future.set_result(result)
+
+    # ------------------------------------------------------- tick thread
+
+    def start(self):
+        """Spawn the dispatcher thread (idempotent)."""
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="raft-serve-batcher")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while True:
+            t0 = time.perf_counter()
+            self.run_tick()
+            with self._cond:
+                if self._stop and not self._pending:
+                    return
+                delay = self.tick_s - (time.perf_counter() - t0)
+                if delay > 0 and not self._stop:
+                    self._cond.wait(timeout=delay)
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def drain(self, timeout=None):
+        """Graceful drain: refuse new work, finish every pending tick,
+        stop the dispatcher.  Every already-accepted Future resolves
+        before this returns (bounded by ``timeout``)."""
+        t0 = time.perf_counter()
+        pend0 = len(self._pending)
+        with self._cond:
+            self._draining = True
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            alive = self._thread.is_alive()
+        else:
+            # never started (socket-free/unit use): flush inline
+            while self._pending or self._in_tick:
+                self.run_tick()
+            alive = False
+        wall = round(time.perf_counter() - t0, 3)
+        log_event("serve_drain", pending=pend0, wall_s=wall,
+                  completed=not alive)
+        return {"pending": pend0, "wall_s": wall, "completed": not alive}
+
+    # -------------------------------------------------------------- misc
+
+    def stats(self):
+        return {
+            "pending": len(self._pending),
+            "draining": self._draining,
+            "tick_ms": self.tick_s * 1e3,
+            "batch_sizes": list(self.sizes),
+            "out_keys": list(self.out_keys),
+            "designs": self.registry.names(),
+            "cache": self.cache.stats(),
+        }

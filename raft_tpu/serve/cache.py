@@ -1,0 +1,155 @@
+"""Content-addressed result cache for evaluation serving.
+
+Sweeps, DoEs and optimizer loops are full of duplicate corners: the
+WEIS per-iterate pattern re-evaluates near-identical designs, DoE
+generators repeat corner cases, and hundreds of concurrent synthetic
+clients hammer the same (design, sea-state) pairs.  The cache keys one
+evaluation by CONTENT — the design-pytree hash, the exact case floats
+and the dispatched out_keys — so a hit is bit-identical to the dispatch
+that produced it, by construction.
+
+LRU with a byte budget (numpy ``nbytes`` accounting): serving holds
+full per-case output rows (PSD/X0/... arrays), so an entry count alone
+would let a few wide-grid designs blow the RSS.  Thread-safe — the
+asyncio loop (submit-time lookups) and the dispatcher thread
+(post-tick inserts) share one instance.
+
+Pure stdlib + numpy; no jax import, usable host-side everywhere
+(:class:`raft_tpu.omdao.DesignEvaluation` reuses it for the optimizer
+repeat-call path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from raft_tpu.obs import metrics
+
+
+def _value_token(v):
+    """Exact content token of one case value: scalar floats by their
+    IEEE bits (never a rounded rendering), arrays by a hash of their
+    raw bytes + dtype + shape."""
+    a = np.asarray(v)
+    if a.dtype == object:
+        return repr(v)
+    if a.size == 1 and np.issubdtype(a.dtype, np.floating):
+        return float(a.reshape(-1)[0]).hex()
+    return hashlib.sha256(
+        a.tobytes() + str(a.dtype).encode() + repr(a.shape).encode()
+    ).hexdigest()
+
+
+def result_cache_key(design_fingerprint, case, out_keys, extra=()):
+    """Stable content key of one evaluation.
+
+    design_fingerprint : the design-pytree hash
+        (:func:`raft_tpu.aot.bank.content_fingerprint` of the design —
+        :func:`raft_tpu.api.pack_for_serving` returns it)
+    case : mapping of case values (``Hs``/``Tp``/``beta`` scalars for
+        the single-case chain; the omdao repeat-call path keys its full
+        traced case dict, arrays included) — keyed by exact content
+        bits, never a rounded rendering
+    out_keys : the DISPATCHED out_keys tuple (a served subset of a
+        wider dispatch shares the wider entry — key on what was
+        computed, not what was asked)
+    extra : anything else that shapes the numbers (trace-time flag
+        key, x64 mode) — the server folds its flag state in here
+    """
+    case_items = tuple(sorted((str(k), _value_token(v))
+                              for k, v in dict(case).items()))
+    blob = repr((str(design_fingerprint), case_items,
+                 tuple(out_keys), tuple(extra)))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _entry_bytes(row):
+    return sum(np.asarray(v).nbytes for v in row.values())
+
+
+class ResultCache:
+    """Byte-budgeted LRU of evaluation rows.
+
+    ``get``/``put`` take/return ``{out_key: numpy array}`` rows (one
+    request's outputs).  Eviction is LRU by access order; an entry
+    larger than the whole budget is simply not cached.  Hit/miss/evict
+    totals feed the metrics registry under ``<prefix>_hits`` /
+    ``_misses`` / ``_evictions`` plus a ``<prefix>_bytes`` gauge, so
+    ``/metrics`` and the bench report the hit rate without touching
+    the instance.
+    """
+
+    def __init__(self, max_bytes, metrics_prefix="serve_cache"):
+        self.max_bytes = int(max_bytes)
+        self._prefix = metrics_prefix
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[str, tuple[dict, int]] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key):
+        """The cached row for ``key`` (a shallow copy — callers slice
+        out_key subsets freely) or ``None``."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                metrics.counter(self._prefix + "_misses").inc()
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            metrics.counter(self._prefix + "_hits").inc()
+            return dict(ent[0])
+
+    def put(self, key, row):
+        """Insert one output row (values coerced to host numpy).  A
+        re-insert under the same key refreshes recency and swaps the
+        payload."""
+        # np.array COPIES: the batcher hands in row-slice VIEWS of the
+        # whole padded dispatch batch — retaining the view would pin
+        # the full batch while charging one row against the budget
+        row = {k: np.array(v) for k, v in row.items()}
+        nbytes = _entry_bytes(row)
+        if nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (row, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, (_, freed) = self._entries.popitem(last=False)
+                self._bytes -= freed
+                self.evictions += 1
+                metrics.counter(self._prefix + "_evictions").inc()
+            metrics.gauge(self._prefix + "_bytes").set(self._bytes)
+        return True
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes(self):
+        with self._lock:
+            return self._bytes
+
+    def stats(self):
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "hit_rate": round(self.hits / total, 4) if total else None,
+            }

@@ -1,0 +1,95 @@
+"""Minimal stdlib client for the evaluation service.
+
+Used by the bench load harness (``RAFT_TPU_BENCH_MODE=serve``) and the
+subprocess tests; keep-alive ``http.client`` connections so hundreds of
+synthetic clients stay cheap.  Not a public SDK — the wire format is
+plain JSON over HTTP (see :mod:`raft_tpu.serve.http`).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+
+
+class ResponseDropped(RuntimeError):
+    """The request was (or may have been) delivered but the connection
+    died before its response arrived.  Deliberately NOT a
+    ``ConnectionError``: callers gating on "no accepted response was
+    dropped" (the bench SIGTERM-drain check) must see this as a drop,
+    never as a clean connection refusal — and the client must never
+    silently re-send a non-idempotent evaluate for it."""
+
+
+class ServeClient:
+    """One keep-alive connection to a service instance."""
+
+    def __init__(self, host, port, client_id=None, timeout=300.0):
+        self.host, self.port = host, int(port)
+        self.client_id = client_id
+        self.timeout = timeout
+        self._conn = None
+
+    def _connection(self):
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout)
+        return self._conn
+
+    def close(self):
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+    def request(self, method, path, payload=None):
+        """One round trip; returns ``(status_code, parsed_body)`` —
+        JSON-decoded when possible, raw text otherwise (``/metrics``)."""
+        body = None
+        headers = {}
+        if payload is not None:
+            body = json.dumps(payload)
+            headers["Content-Type"] = "application/json"
+        if self.client_id:
+            headers["X-Client"] = str(self.client_id)
+        conn = self._connection()
+        try:
+            conn.request(method, path, body=body, headers=headers)
+        except (http.client.HTTPException, ConnectionError, OSError):
+            # SEND failed — the server never processed the request, so
+            # one fresh-connection retry is safe even for POST (covers
+            # the stale-keep-alive race)
+            self.close()
+            conn = self._connection()
+            conn.request(method, path, body=body, headers=headers)
+        try:
+            resp = conn.getresponse()
+            data = resp.read()
+        except (http.client.HTTPException, ConnectionError, OSError) as e:
+            # the request may have been ACCEPTED: re-sending would
+            # duplicate a non-idempotent evaluation (and eat a second
+            # quota token), and calling this a refusal would hide a
+            # dropped response from the drain gate
+            self.close()
+            raise ResponseDropped(
+                f"connection lost awaiting {method} {path}: {e!r}") from e
+        if resp.will_close:
+            self.close()
+        try:
+            return resp.status, json.loads(data)
+        except ValueError:
+            return resp.status, data.decode(errors="replace")
+
+    def evaluate(self, design, Hs, Tp, beta, out_keys=None,
+                 escalate_f64=False):
+        payload = {"design": design, "Hs": Hs, "Tp": Tp, "beta": beta}
+        if out_keys:
+            payload["out_keys"] = list(out_keys)
+        if escalate_f64:
+            payload["escalate_f64"] = True
+        return self.request("POST", "/evaluate", payload)
+
+    def healthz(self):
+        return self.request("GET", "/healthz")
+
+    def metrics_text(self):
+        return self.request("GET", "/metrics")

@@ -1,0 +1,320 @@
+"""Design registry + packed-row dispatch of the evaluation service.
+
+The engine owns the jax-facing half of serving: it resolves designs
+into packed bucket rows (:func:`raft_tpu.api.pack_for_serving`) and
+dispatches coalesced request groups through the SAME
+``_cached_jit``/AOT-bank funnel the batch sweeps use
+(:func:`raft_tpu.parallel.sweep._cached_jit` with the
+``sweep_heterogeneous`` ``"bucket"`` memo key) — a program warmed by
+``python -m raft_tpu.aot warmup --kinds serve`` (or by any
+heterogeneous sweep at the same batch size) is THE program a serving
+tick loads, so a warmed fresh server answers its first request with
+zero backend compilations.
+
+Batch sizes are a fixed pow2 **ladder** (``dp``-based:
+``dp, 2*dp, 4*dp, ... <= RAFT_TPU_SERVE_MAX_BATCH``): every dispatch
+pads its rows up to the next ladder size with masked repeat rows
+(dropped on fan-out), so arbitrary tick occupancies reuse a handful of
+compiled programs instead of minting one per pending count.  The
+ladder is exactly what the ``serve`` warmup kind warms.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from raft_tpu.obs import metrics
+from raft_tpu.obs.spans import span
+from raft_tpu.structure import bucketing
+from raft_tpu.utils import config
+from raft_tpu.utils.structlog import log_event
+
+#: the default dispatched out_keys — ``status`` is NON-OPTIONAL (the
+#: per-request error semantics read it), :func:`normalize_out_keys`
+#: enforces it
+DEFAULT_OUT_KEYS = ("PSD", "X0", "status")
+
+
+def normalize_out_keys(out_keys):
+    """The dispatched out_keys tuple: caller order preserved,
+    ``status`` appended when missing.  Both the batcher and the
+    ``serve`` warmup kind normalize through here — the out_keys tuple
+    is part of the program memo/bank key, so they must agree exactly."""
+    keys = tuple(out_keys or DEFAULT_OUT_KEYS)
+    return keys if "status" in keys else keys + ("status",)
+
+
+class DesignEntry:
+    """One registered design: the built model resolved into its bucket
+    routing key, packed batch row and cache fingerprint."""
+
+    __slots__ = ("name", "model", "sig", "packed", "fingerprint")
+
+    def __init__(self, name, model):
+        from raft_tpu.api import pack_for_serving
+
+        self.name = name
+        self.model = model
+        self.sig, self.packed, self.fingerprint = pack_for_serving(model)
+
+    def __repr__(self):
+        return (f"DesignEntry({self.name!r}, "
+                f"bucket={bucketing.signature_fingerprint(self.sig)})")
+
+
+class Registry:
+    """Named design registry + content-addressed inline-design cache.
+
+    ``register`` builds the model once at startup (host build seconds,
+    paid before the socket binds); inline per-request designs go
+    through :meth:`resolve_inline`, which caches built entries by
+    design-content fingerprint so a tenant re-posting the same YAML
+    pays the build once.  The inline cache is LRU-BOUNDED
+    (``max_inline``): a full Model + packed pytree is megabytes, and an
+    optimizer tenant posting a slightly different design every iterate
+    (the WEIS pattern) must recycle slots, not grow the always-on
+    server's RSS without limit."""
+
+    def __init__(self, max_inline=32):
+        self._by_name: dict[str, DesignEntry] = {}
+        self._max_inline = int(max_inline)
+        self._inline: dict[str, DesignEntry] = {}  # fingerprint -> entry
+
+    def register(self, name, design):
+        """Build + pack one design (path or dict) under ``name``
+        (named registrations are permanent — startup designs)."""
+        entry = self._build(name, design)
+        self._by_name[entry.name] = entry
+        return entry
+
+    def _build(self, name, design):
+        import raft_tpu
+
+        base_dir = (os.path.dirname(os.path.abspath(design))
+                    if isinstance(design, str) else None)
+        model = raft_tpu.Model(design, base_dir=base_dir)
+        return DesignEntry(str(name), model)
+
+    def get(self, name):
+        return self._by_name.get(str(name))
+
+    def resolve_inline(self, design_dict):
+        """Entry for an inline design dict: built + LRU-cached by
+        content fingerprint (repeat posts hit; the least-recently-used
+        inline entry is dropped past ``max_inline``)."""
+        from raft_tpu.aot.bank import content_fingerprint
+
+        fp = content_fingerprint(design_dict)
+        for named in self._by_name.values():   # inline post of a
+            if named.fingerprint == fp:        # registered design
+                return named
+        entry = self._inline.get(fp)
+        if entry is not None:
+            self._inline.pop(fp)       # refresh recency (insert order)
+            self._inline[fp] = entry
+            return entry
+        metrics.counter("serve_inline_designs").inc()
+        entry = self._build(f"inline-{fp[:12]}", design_dict)
+        while len(self._inline) >= self._max_inline:
+            self._inline.pop(next(iter(self._inline)))
+            metrics.counter("serve_inline_evictions").inc()
+        self._inline[fp] = entry
+        return entry
+
+    def names(self):
+        return sorted(self._by_name)
+
+    def __len__(self):
+        return len(self._by_name)
+
+
+# --------------------------------------------------------------- dispatch
+
+
+def batch_ladder(mesh, max_batch=None):
+    """The fixed padded batch sizes the service dispatches (and the
+    ``serve`` warmup kind warms): ``dp, 2*dp, ...`` up to
+    ``RAFT_TPU_SERVE_MAX_BATCH`` (at least one rung)."""
+    dp = mesh.shape.get("dp", 1)
+    if max_batch is None:
+        max_batch = int(config.get("SERVE_MAX_BATCH"))
+    sizes = [dp]
+    while sizes[-1] * 2 <= max(max_batch, dp):
+        sizes.append(sizes[-1] * 2)
+    return tuple(sizes)
+
+
+def pick_padded(n, sizes):
+    """Smallest ladder size holding ``n`` rows (callers chunk to
+    ``sizes[-1]`` first)."""
+    for s in sizes:
+        if s >= n:
+            return s
+    return sizes[-1]
+
+
+def _pad1(a, rows):
+    a = np.asarray(a, dtype=float)
+    if len(a) == rows:
+        return a
+    return np.concatenate([a, np.full(rows - len(a), a[-1])])
+
+
+def flags_extra():
+    """The trace-time state that shapes served numbers beyond the
+    design + case — folded into every result-cache key so a flag flip
+    (dtype policy, escalation iteration scale) never serves stale
+    rows."""
+    import jax
+
+    from raft_tpu.parallel.sweep import _flags_key
+
+    return _flags_key() + (bool(jax.config.jax_enable_x64),)
+
+
+def dispatch(entries, Hs, Tp, beta, out_keys=DEFAULT_OUT_KEYS, mesh=None,
+             padded=None, record_metrics=True):
+    """Evaluate one coalesced request group (ONE bucket signature).
+
+    entries : per-row :class:`DesignEntry` (repeat an entry to evaluate
+        it under several sea states)
+    Hs/Tp/beta : per-row scalars, aligned with ``entries``
+    padded : the program batch size (a :func:`batch_ladder` rung);
+        default: the smallest rung holding the rows
+    record_metrics : False for non-serving traffic (startup warmup) so
+        the occupancy/dispatch metrics describe ONLY real request load
+
+    Returns ``{out_key: host numpy array}`` of length ``len(entries)``
+    (padding rows dropped).  The memo/bank key is IDENTICAL to
+    :func:`raft_tpu.parallel.sweep.sweep_heterogeneous`'s per-bucket
+    key, so serving, sweeps and warmup all share programs.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from raft_tpu.parallel.sweep import (_cached_jit, _flags_key, _mesh_key,
+                                         make_mesh)
+    from raft_tpu.utils.devices import enable_compile_cache
+
+    enable_compile_cache()
+    if mesh is None:
+        mesh = make_mesh()
+    n = len(entries)
+    if n == 0:
+        raise ValueError("empty dispatch group")
+    sig = entries[0].sig
+    if any(e.sig != sig for e in entries):
+        raise ValueError("dispatch group mixes bucket signatures — the "
+                         "batcher groups by signature before dispatching")
+    if padded is None:
+        padded = pick_padded(n, batch_ladder(mesh))
+    if padded < n or padded % mesh.shape.get("dp", 1):
+        raise ValueError(f"padded batch {padded} cannot hold {n} rows on "
+                         f"mesh {dict(mesh.shape)}")
+
+    ev = bucketing.get_bucket_evaluator(sig)
+    case = dict(
+        design=bucketing.stack_packed([e.packed for e in entries], padded),
+        Hs=_pad1(Hs, padded), Tp=_pad1(Tp, padded), beta=_pad1(beta, padded))
+    sharding = NamedSharding(mesh, P("dp"))
+    in_sh = jax.tree_util.tree_map(lambda _: sharding, case)
+
+    def build(ev=ev, in_sh=in_sh, keys=tuple(out_keys)):
+        def one(c):
+            with jax.named_scope("sweep_bucket"):
+                return {kk: ev(c)[kk] for kk in keys}
+
+        return jax.jit(jax.vmap(one), in_shardings=(in_sh,))
+
+    fn = _cached_jit(ev, ("bucket", tuple(out_keys), sig, _mesh_key(mesh),
+                          _flags_key()), build)
+    # host-numpy device_put: no resharding program, no compile event
+    # (see sweep_cases)
+    args = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(np.asarray(x), s), case, in_sh)
+    with span("sweep_dispatch", kind="serve", rows=n,
+              bucket=bucketing.signature_fingerprint(sig)):
+        res = fn(args)
+        res = {kk: np.asarray(res[kk])[:n] for kk in out_keys}
+    if record_metrics:
+        metrics.counter("serve_dispatches").inc()
+        metrics.counter("serve_rows_dispatched").inc(n)
+        metrics.histogram("serve_batch_rows").observe(n)
+        metrics.histogram("serve_batch_occupancy").observe(n / padded)
+    return res
+
+
+def escalate_row(entry, Hs, Tp, beta, out_keys=DEFAULT_OUT_KEYS, mesh=None):
+    """Quarantine-style f64 re-solve of ONE request (per-request
+    opt-in): re-dispatch the row solo under the escalation ladder's
+    ``f64_cpu`` rung flags (float64 compute policy on a CPU mesh,
+    relaxed compile budget — :func:`raft_tpu.parallel.resilience.
+    _rung_flags`).  Returns ``(row, status_after)``; adoption policy is
+    the caller's (the batcher only adopts a HEALTHY re-solve, like the
+    sweep quarantine)."""
+    from raft_tpu.parallel import resilience
+    from raft_tpu.parallel.sweep import make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    metrics.counter("serve_escalations").inc()
+    with resilience._rung_flags("f64_cpu"):
+        emesh = resilience._rung_mesh("f64_cpu", mesh)
+        out = dispatch([entry], [Hs], [Tp], [beta], out_keys, mesh=emesh,
+                       padded=emesh.shape.get("dp", 1))
+    row = {kk: out[kk][0] for kk in out_keys}
+    return row, int(np.asarray(row["status"]))
+
+
+# ----------------------------------------------------------------- warmup
+
+
+def warm(entries, mesh=None, out_keys=DEFAULT_OUT_KEYS, sizes=None):
+    """Warm every program the service will dispatch for ``entries``:
+    one dispatch per (bucket signature x ladder size) with synthetic
+    sea states, through the production funnel — under
+    ``RAFT_TPU_AOT=load`` each program is bank-loaded or
+    compiled+exported; under ``require`` a cold bank fails HERE, before
+    any client is waiting.  Returns per-program report dicts."""
+    import jax
+
+    from raft_tpu.parallel.sweep import make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    if sizes is None:
+        sizes = batch_ladder(mesh)
+    out_keys = normalize_out_keys(out_keys)
+    by_sig: dict = {}
+    for e in entries:
+        by_sig.setdefault(e.sig, []).append(e)
+    reports = []
+    rng = np.random.default_rng(0)
+    for sig, group in by_sig.items():
+        for rows in sizes:
+            row_entries = [group[i % len(group)] for i in range(rows)]
+            c0 = {k: metrics.counter(k).value for k in
+                  ("aot_programs_loaded", "aot_programs_compiled")}
+            t0 = time.perf_counter()
+            out = dispatch(row_entries, rng.uniform(2.0, 8.0, rows),
+                           rng.uniform(6.0, 14.0, rows),
+                           rng.uniform(-0.5, 0.5, rows),
+                           out_keys=out_keys, mesh=mesh, padded=rows,
+                           record_metrics=False)
+            jax.block_until_ready(out)
+            rep = dict(
+                kind="serve", rows=rows,
+                bucket=bucketing.signature_fingerprint(sig),
+                wall_s=round(time.perf_counter() - t0, 2),
+                loaded=metrics.counter("aot_programs_loaded").value
+                - c0["aot_programs_loaded"],
+                compiled=metrics.counter("aot_programs_compiled").value
+                - c0["aot_programs_compiled"])
+            log_event("aot_warmup", kind="serve", n=rows,
+                      loaded=rep["loaded"], compiled=rep["compiled"],
+                      wall_s=rep["wall_s"])
+            reports.append(rep)
+    return reports

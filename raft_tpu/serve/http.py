@@ -1,0 +1,354 @@
+"""Asyncio HTTP front end of the evaluation service (stdlib only).
+
+A deliberately small HTTP/1.1 implementation over ``asyncio.
+start_server`` — request line + headers + Content-Length body,
+keep-alive connections, JSON in/out.  No framework, no new deps.
+
+Routes
+------
+``POST /evaluate``  body::
+
+        {"design": "spar",            # registered name, or
+         "design_inline": {...},      # inline design dict (YAML-as-JSON)
+         "Hs": 6.0, "Tp": 11.0, "beta": 0.0,
+         "out_keys": ["PSD", "X0", "status"],   # optional subset
+         "escalate_f64": false}                 # quarantine-style re-solve
+
+    → 200 with ``{"ok": true, "status": <int32 word>, "status_text",
+    "cache_hit", "escalated", "outputs": {...}}``; a result carrying
+    SEVERE health bits returns **422** with the same body plus the
+    ``describe()`` error text (numbers included — suspect, not absent);
+    backpressure returns **429** (per-client quota, with Retry-After)
+    or **503** (admission queue full / draining).
+
+``GET /healthz``    liveness + warmup provenance (programs loaded vs
+                    compiled, real XLA compiles, cache + batcher stats)
+``GET /metrics``    the process metrics registry in Prometheus text
+                    exposition format (the ``RAFT_TPU_METRICS`` file
+                    exporter's live HTTP twin)
+``GET /designs``    registered design names
+
+Shutdown: SIGTERM/SIGINT triggers a graceful drain — stop accepting,
+finish in-flight ticks (every accepted request gets its response),
+flush metrics (``RAFT_TPU_METRICS`` path when set), then exit.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import signal
+import time
+
+import numpy as np
+
+from raft_tpu.obs import metrics
+from raft_tpu.serve import batcher as batcher_mod
+from raft_tpu.utils import config
+from raft_tpu.utils.structlog import log_event
+
+_T0 = time.perf_counter()
+
+_STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                405: "Method Not Allowed", 408: "Request Timeout",
+                413: "Payload Too Large", 422: "Unprocessable Entity",
+                429: "Too Many Requests", 500: "Internal Server Error",
+                503: "Service Unavailable"}
+
+MAX_BODY_BYTES = 8 * 1024 * 1024
+
+
+def _json_value(v):
+    """JSON-encode one output leaf: numpy arrays to nested lists,
+    complex values split into real/imag."""
+    a = np.asarray(v)
+    if np.iscomplexobj(a):
+        return {"real": a.real.tolist(), "imag": a.imag.tolist()}
+    return a.tolist()
+
+
+def encode_result(result):
+    """The JSON body of one evaluation result payload."""
+    return {
+        "ok": not result["severe"],
+        "status": result["status"],
+        "status_text": result["status_text"],
+        "cache_hit": result["cache_hit"],
+        "escalated": result["escalated"],
+        "outputs": {k: _json_value(v)
+                    for k, v in result["outputs"].items()},
+    }
+
+
+class Server:
+    """One service instance: batcher + asyncio HTTP endpoint."""
+
+    def __init__(self, batcher, host="127.0.0.1", port=8787):
+        self.batcher = batcher
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(config.get("SERVE_TIMEOUT_S"))
+        self._server = None
+        self._stop = None
+        self._handlers = set()
+
+    # ------------------------------------------------------------ routes
+
+    async def _evaluate(self, body, client):
+        try:
+            payload = json.loads(body or b"{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            return 400, {"ok": False, "error": f"bad JSON body: {e}"}
+        if not isinstance(payload, dict):
+            return 400, {"ok": False, "error": "body must be a JSON object"}
+        client = payload.get("client") or client
+        loop = asyncio.get_running_loop()
+        entry = None
+        if payload.get("design_inline") is not None:
+            # building an inline design is host work (YAML schema +
+            # model build) — keep it off the event loop
+            try:
+                entry = await loop.run_in_executor(
+                    None, self.batcher.registry.resolve_inline,
+                    payload["design_inline"])
+            except Exception as e:  # noqa: BLE001 — tenant input
+                return 400, {"ok": False,
+                             "error": f"inline design rejected: {e!r}"}
+        else:
+            name = payload.get("design")
+            if not name:
+                return 400, {"ok": False,
+                             "error": "missing 'design' (or 'design_inline')"}
+            entry = self.batcher.registry.get(name)
+            if entry is None:
+                return 404, {"ok": False, "error": f"unknown design {name!r}"}
+        # the case scalars are REQUIRED: silently defaulting a missing
+        # (or misspelled) Hs/Tp/beta would evaluate the wrong sea state
+        # and return it as ok:true — in a parity-gated service, wrong
+        # numbers must never be quieter than a 400
+        missing = [k for k in ("Hs", "Tp", "beta") if k not in payload]
+        if missing:
+            return 400, {"ok": False,
+                         "error": f"missing case scalar(s) {missing}"}
+        try:
+            case = {k: float(payload[k]) for k in ("Hs", "Tp", "beta")}
+        except (TypeError, ValueError):
+            return 400, {"ok": False, "error": "Hs/Tp/beta must be numbers"}
+        out_keys = payload.get("out_keys")
+        if out_keys is not None and not (
+                isinstance(out_keys, list)
+                and all(isinstance(k, str) for k in out_keys)):
+            return 400, {"ok": False, "error": "out_keys must be a string list"}
+        try:
+            fut = self.batcher.submit(
+                entry, case["Hs"], case["Tp"], case["beta"],
+                out_keys=tuple(out_keys) if out_keys else None,
+                escalate_f64=bool(payload.get("escalate_f64")),
+                client=client)
+        except batcher_mod.QuotaExceeded as e:
+            return 429, {"ok": False, "error": "client quota exceeded",
+                         "retry_after_s": round(e.retry_after_s, 3)}
+        except batcher_mod.RejectError as e:
+            return 503, {"ok": False, "error": str(e), "reason": e.reason}
+        except ValueError as e:
+            return 400, {"ok": False, "error": str(e)}
+        try:
+            result = await asyncio.wait_for(asyncio.wrap_future(fut),
+                                            timeout=self.timeout_s)
+        except asyncio.TimeoutError:
+            fut.cancel()
+            return 408, {"ok": False,
+                         "error": f"evaluation exceeded {self.timeout_s}s"}
+        except Exception as e:  # noqa: BLE001 — dispatch failure
+            return 500, {"ok": False, "error": repr(e)[:300]}
+        return (422 if result["severe"] else 200), encode_result(result)
+
+    def _healthz(self):
+        from raft_tpu.analysis.recompile import PROCESS_LOG
+
+        snap = {c: metrics.counter(c).value for c in
+                ("aot_programs_loaded", "aot_programs_compiled",
+                 "serve_requests", "serve_dispatches",
+                 "serve_rows_dispatched", "serve_coalesced",
+                 "serve_rejected_quota", "serve_rejected_queue",
+                 "serve_errors", "serve_escalations")}
+        occ = metrics.histogram("serve_batch_occupancy").snapshot()
+        lat = metrics.histogram("serve_request_s").snapshot()
+        return 200, {
+            "ok": True,
+            "draining": self.batcher.draining,
+            "uptime_s": round(time.perf_counter() - _T0, 3),
+            "xla_compiles": PROCESS_LOG.count,
+            "xla_real_compiles": PROCESS_LOG.real_count,
+            "batch_occupancy": occ,
+            "request_latency_s": lat,
+            **self.batcher.stats(),
+            **snap,
+        }
+
+    async def _route(self, method, path, body, client):
+        if path == "/evaluate":
+            if method != "POST":
+                return 405, {"ok": False, "error": "POST required"}
+            if self.batcher.draining:
+                return 503, {"ok": False, "error": "service is draining",
+                             "reason": "draining"}
+            return await self._evaluate(body, client)
+        if method != "GET":
+            return 405, {"ok": False, "error": "GET required"}
+        if path == "/healthz":
+            return self._healthz()
+        if path == "/metrics":
+            return 200, metrics.to_prometheus()  # text, not JSON
+        if path == "/designs":
+            return 200, {"ok": True, "designs": self.batcher.registry.names()}
+        return 404, {"ok": False, "error": f"no route {path}"}
+
+    # -------------------------------------------------------- connection
+
+    async def _read_request(self, reader):
+        """One HTTP request off the stream: (method, path, headers,
+        body), or None on clean EOF."""
+        line = await reader.readline()
+        if not line:
+            return None
+        parts = line.decode("latin-1").strip().split()
+        if len(parts) < 2:
+            raise ValueError(f"bad request line {line!r}")
+        method, path = parts[0].upper(), parts[1].split("?", 1)[0]
+        headers = {}
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = h.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        n = int(headers.get("content-length", 0) or 0)
+        if n > MAX_BODY_BYTES:
+            raise ValueError(f"body of {n} bytes exceeds {MAX_BODY_BYTES}")
+        body = await reader.readexactly(n) if n else b""
+        return method, path, headers, body
+
+    @staticmethod
+    def _response_bytes(status, payload, keep_alive):
+        if isinstance(payload, (dict, list)):
+            data = json.dumps(payload).encode()
+            ctype = "application/json"
+        else:
+            data = str(payload).encode()
+            ctype = "text/plain; version=0.0.4"
+        head = [f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Unknown')}",
+                f"Content-Type: {ctype}",
+                f"Content-Length: {len(data)}",
+                f"Connection: {'keep-alive' if keep_alive else 'close'}"]
+        if status == 429 and isinstance(payload, dict):
+            head.append(
+                f"Retry-After: {max(1, int(payload.get('retry_after_s') or 0) + 1)}")
+        return ("\r\n".join(head) + "\r\n\r\n").encode() + data
+
+    async def _handle(self, reader, writer):
+        task = asyncio.current_task()
+        self._handlers.add(task)
+        peer = writer.get_extra_info("peername")
+        peer_host = peer[0] if isinstance(peer, tuple) else "?"
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except (ValueError, asyncio.IncompleteReadError) as e:
+                    writer.write(self._response_bytes(
+                        400, {"ok": False, "error": str(e)[:200]}, False))
+                    await writer.drain()
+                    break
+                if req is None:
+                    break
+                method, path, headers, body = req
+                client = headers.get("x-client") or peer_host
+                t0 = time.perf_counter()
+                try:
+                    status, payload = await self._route(method, path, body,
+                                                        client)
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    status, payload = 500, {"ok": False,
+                                            "error": repr(e)[:300]}
+                keep = (headers.get("connection", "keep-alive").lower()
+                        != "close") and not self.batcher.draining
+                writer.write(self._response_bytes(status, payload, keep))
+                await writer.drain()
+                log_event("serve_request", endpoint=path, method=method,
+                          code=status, client=str(client),
+                          wall_s=round(time.perf_counter() - t0, 6),
+                          cache_hit=bool(payload.get("cache_hit"))
+                          if isinstance(payload, dict) else False)
+                metrics.counter("serve_http_requests").inc()
+                if not keep:
+                    break
+        finally:
+            self._handlers.discard(task)
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    # ------------------------------------------------------------- serve
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, self._stop.set)
+            except (NotImplementedError, RuntimeError):
+                pass
+        self.batcher.start()
+        log_event("serve_start", host=self.host, port=self.port,
+                  designs=self.batcher.registry.names(),
+                  tick_ms=self.batcher.tick_s * 1e3,
+                  batch_sizes=list(self.batcher.sizes))
+        return self
+
+    async def serve_until_stopped(self):
+        """Block until SIGTERM/SIGINT, then drain gracefully."""
+        await self._stop.wait()
+        await self.shutdown()
+
+    async def shutdown(self):
+        """Graceful drain: refuse new work, finish in-flight requests,
+        flush metrics."""
+        t0 = time.perf_counter()
+        loop = asyncio.get_running_loop()
+        # 1. stop accepting new connections; mark draining so keep-alive
+        #    connections get 503 for new requests
+        self._server.close()
+        # 2. finish every accepted request (the batcher resolves all
+        #    pending futures before drain() returns)
+        drain_s = float(config.get("SERVE_DRAIN_S"))
+        await loop.run_in_executor(None, self.batcher.drain, drain_s)
+        # 3. let the open handlers write their final responses
+        handlers = {t for t in self._handlers if not t.done()}
+        if handlers:
+            await asyncio.wait(handlers, timeout=drain_s)
+        for t in list(self._handlers):
+            t.cancel()
+        await self._server.wait_closed()
+        # 4. flush metrics for the scrape-at-exit consumers
+        path = config.get("METRICS")
+        if path:
+            metrics.export(path)
+        log_event("serve_stop",
+                  requests=metrics.counter("serve_requests").value,
+                  wall_s=round(time.perf_counter() - t0, 3))
+
+
+async def run_server(batcher, host="127.0.0.1", port=8787, ready=None):
+    """Start + block until signalled.  ``ready(server)`` runs after the
+    socket binds (the CLI prints its ready line there)."""
+    server = await Server(batcher, host, port).start()
+    if ready is not None:
+        ready(server)
+    await server.serve_until_stopped()
+    return server

@@ -248,6 +248,33 @@ def pack_design(model, sig=None):
     return d
 
 
+def stack_packed(packed_list, rows=None):
+    """Stack packed-design pytrees into the batch a bucket evaluator
+    vmaps over — the request→packed-row adapter of the serving batcher
+    (:mod:`raft_tpu.serve`) and of any caller that already holds
+    :func:`pack_design` outputs.
+
+    ``packed_list`` : per-row packed pytrees of ONE bucket signature
+        (row i of the batch evaluates design i).
+    ``rows`` : pad the batch up to this many rows by repeating the last
+        entry (masked repeat rows, dropped again by the caller on
+        fan-out) — the serving tick pads to its fixed program sizes so
+        every occupancy shares one compiled program.
+
+    Returns the stacked dict of numpy leaves (leading axis = rows).
+    """
+    if not packed_list:
+        raise ValueError("stack_packed: empty packed-design batch")
+    n = len(packed_list)
+    rows = n if rows is None else int(rows)
+    if rows < n:
+        raise ValueError(
+            f"stack_packed: {n} rows exceed the requested batch {rows}")
+    take = list(range(n)) + [n - 1] * (rows - n)
+    return {k: np.stack([packed_list[i][k] for i in take])
+            for k in packed_list[0]}
+
+
 def padding_waste_frac(packed_list):
     """Fraction of padded strip rows that carry no real strip, over a
     batch of packed designs: ``1 - sum(valid) / sum(padded)`` — the

@@ -274,6 +274,35 @@ _register(
               "structured-log record (set by the coordinator for "
               "spawned workers; per-worker event streams stay "
               "separable in one shared RAFT_TPU_LOG capture)"),
+    # -- evaluation service (see raft_tpu.serve and README "Evaluation
+    #    service")
+    Flag("SERVE_TICK_MS", "float", 20.0,
+         help="continuous-batching tick period: pending requests "
+              "coalesce into one bucketed dispatch per (signature, "
+              "tick) — lower = lower queueing latency, higher = bigger "
+              "batches"),
+    Flag("SERVE_MAX_BATCH", "int", 64,
+         help="largest padded batch one serving dispatch holds; the "
+              "batch ladder is dp,2*dp,... up to this (programs are "
+              "compiled/banked per ladder size — warm with the SAME "
+              "value: python -m raft_tpu.aot warmup --kinds serve)"),
+    Flag("SERVE_CACHE_MB", "float", 64.0,
+         help="byte budget of the content-addressed result cache "
+              "(design hash + case + out_keys -> outputs, LRU)"),
+    Flag("SERVE_QUEUE", "int", 1024,
+         help="admission-queue bound: requests past this many pending "
+              "get 503 (backpressure) instead of an unbounded backlog"),
+    Flag("SERVE_QPS", "float", 0.0,
+         help="per-client token-bucket sustained rate (requests/s); "
+              "0 disables quotas.  An over-quota client gets 429 with "
+              "Retry-After"),
+    Flag("SERVE_BURST", "float", 32.0,
+         help="per-client token-bucket burst capacity"),
+    Flag("SERVE_TIMEOUT_S", "float", 300.0,
+         help="per-request evaluation timeout at the HTTP layer (408)"),
+    Flag("SERVE_DRAIN_S", "float", 120.0,
+         help="graceful-shutdown budget: SIGTERM finishes in-flight "
+              "ticks and open responses within this window"),
     # -- multi-host distributed runtime (dryrun-tested on CPU; wired
     #    into resilience.resolve_mesh for real pods)
     Flag("DIST", "bool", False,
@@ -326,4 +355,13 @@ _register(
     Flag("BENCH_FABRIC_WORKERS", "str", "1,2,4",
          help="comma list of worker counts the bench fabric block "
               "measures"),
+    Flag("BENCH_SERVE_CLIENTS", "int", 200,
+         help="concurrent synthetic clients in the serve load test "
+              "(RAFT_TPU_BENCH_MODE=serve)"),
+    Flag("BENCH_SERVE_REQS", "int", 4,
+         help="requests each synthetic serve-bench client issues"),
+    Flag("BENCH_SERVE_POOL", "int", 48,
+         help="distinct (Hs,Tp,beta) cases the serve-bench clients "
+              "draw from (smaller pool = more duplicate corners = "
+              "higher cache/coalescing hit rates)"),
 )
