@@ -1083,17 +1083,28 @@ def run_fabric_bench(t_start=None):
                 return sum((st.get("counters") or {}).get(key, 0)
                            for st in states.values())
 
+            from raft_tpu.aot import bank
+
+            pooled = ledger.pooled_walls()
             runs[str(w)] = dict(
                 wall_s=round(wall, 2),
                 window_s=round(window, 2),
                 evals_per_s=round(n / window, 3),
                 evals_per_s_incl_startup=round(n / wall, 3),
+                shard_wall_p50_s=(round(pooled.percentile(0.50), 3)
+                                  if pooled.count else None),
+                shard_wall_p95_s=(round(pooled.percentile(0.95), 3)
+                                  if pooled.count else None),
                 steals=csum("shards_stolen"),
                 shard_retries=csum("shard_retries"),
                 programs_loaded=sum(st.get("programs_loaded") or 0
                                     for st in states.values()),
                 programs_compiled=sum(st.get("programs_compiled") or 0
                                       for st in states.values()),
+                # fleet-merged device-cost ledger: per-program flops and
+                # the achieved GFLOP/s across this config's workers
+                programs=bank.merge_ledgers(
+                    [st.get("programs") for st in states.values()]),
             )
             shutil.rmtree(out_dir, ignore_errors=True)
     finally:
@@ -1283,6 +1294,7 @@ def run_serve_bench(t_start=None):
         c = ServeClient("127.0.0.1", port)
         _, health = c.healthz()
         occ = health.get("batch_occupancy") or {}
+        win = health.get("window") or {}
         block["server"] = dict(
             programs_loaded=health.get("aot_programs_loaded"),
             programs_compiled=health.get("aot_programs_compiled"),
@@ -1293,6 +1305,18 @@ def run_serve_bench(t_start=None):
             batch_occupancy_mean=occ.get("mean"),
             batch_occupancy_p95=occ.get("p95"),
             cache=health.get("cache"),
+            # the server's own sliding-window latency view (last
+            # RAFT_TPU_SERVE_WINDOW_S seconds) next to the client-side
+            # lifetime percentiles above, plus SLO breach accounting
+            window_p50_ms=(round(win["p50"] * 1e3, 1)
+                           if win.get("p50") is not None else None),
+            window_p95_ms=(round(win["p95"] * 1e3, 1)
+                           if win.get("p95") is not None else None),
+            window_rate_per_s=win.get("rate_per_s"),
+            slo=health.get("slo"),
+            # device-cost ledger: per-program flops / dispatches /
+            # achieved GFLOP/s from the warmed bank's sidecars
+            cost_ledger=health.get("cost_ledger"),
         )
         c.close()
 

@@ -31,3 +31,11 @@ python -m raft_tpu.analysis contracts
 # jax or source fingerprints) are reported but don't fail — `python -m
 # raft_tpu.aot gc` reclaims them.  Trivially clean on an empty bank.
 python -m raft_tpu.aot verify
+
+# cross-process trace assembly: the checked-in two-process capture
+# (coordinator + fabric worker, per-process clock anchors) must merge
+# onto one timeline with every span balanced and every parent id
+# resolving (no orphan spans) — the distributed-tracing contract the
+# fabric/serve propagation relies on
+python -m raft_tpu.obs trace --merge tests/fixtures/obs \
+    -o /tmp/raft_obs_merge_check.json --check > /dev/null

@@ -41,6 +41,13 @@ codebase (or was fixed by hand in PR 2 and must stay fixed):
     consumer (``python -m raft_tpu.obs report``/``trace``, quarantine
     forensics) sees only half the story.
 
+``span-name``
+    ``span("<name>", ...)`` calls whose literal span name is not
+    registered in the ``SPANS`` table of :mod:`raft_tpu.obs.events` —
+    the same typo class as ``event-name``, for the wall-time tree: an
+    unregistered name silently forks the span hierarchy and mints a
+    stray ``span_<name>_s`` histogram nobody is reading.
+
 Suppression: append ``# raft-lint: disable=<rule>[,<rule>]`` to the
 offending line (or put it alone on the line above); a file-level
 ``# raft-lint: disable-file=<rule>`` comment disables a rule for the
@@ -63,9 +70,11 @@ RULES = {
     "env-read": "raw RAFT_TPU_* env read outside raft_tpu.utils.config",
     "jit-static": "jax.jit of config-like args without static_argnames",
     "event-name": "log_event() with an unregistered event name",
+    "span-name": "obs.span() with an unregistered span name",
 }
 
 _EVENT_NAMES = None
+_SPAN_NAMES = None
 
 
 def _event_names():
@@ -81,6 +90,20 @@ def _event_names():
         except Exception:
             _EVENT_NAMES = frozenset()
     return _EVENT_NAMES
+
+
+def _span_names():
+    """Registered span names (same lazy/fail-open contract as
+    :func:`_event_names`)."""
+    global _SPAN_NAMES
+    if _SPAN_NAMES is None:
+        try:
+            from raft_tpu.obs.events import SPANS
+
+            _SPAN_NAMES = frozenset(SPANS)
+        except Exception:
+            _SPAN_NAMES = frozenset()
+    return _SPAN_NAMES
 
 # modules whose code runs under jax tracing: the host-coercion rule
 # only applies here.  Host-orchestration modules (drivers, outputs,
@@ -257,6 +280,7 @@ class _Linter(ast.NodeVisitor):
         self._check_env_read(node)
         self._check_jit_static(node)
         self._check_event_name(node)
+        self._check_span_name(node)
         self.generic_visit(node)
 
     def visit_Subscript(self, node):
@@ -386,6 +410,27 @@ class _Linter(ast.NodeVisitor):
                 f"log_event({name.value!r}): event name not registered "
                 "in raft_tpu/obs/events.py — a typo'd name silently "
                 "splits the event stream for every consumer")
+
+    def _check_span_name(self, node):
+        # span("name", ...) / obs.span("name", ...) / spans.span(...);
+        # dynamic first args (a variable name) are not checkable
+        fn = node.func
+        is_span = ((isinstance(fn, ast.Name) and fn.id == "span")
+                   or (isinstance(fn, ast.Attribute) and fn.attr == "span"
+                       and _attr_root(fn) in ("obs", "spans")))
+        if not is_span or not node.args:
+            return
+        name = node.args[0]
+        if not (isinstance(name, ast.Constant)
+                and isinstance(name.value, str)):
+            return
+        registry = _span_names()
+        if registry and name.value not in registry:
+            self._emit(
+                "span-name", node,
+                f"span({name.value!r}): span name not registered in the "
+                "SPANS table of raft_tpu/obs/events.py — a typo'd name "
+                "silently forks the wall-time tree for every consumer")
 
     def _check_jit_static(self, node):
         if not (isinstance(node.func, ast.Attribute)

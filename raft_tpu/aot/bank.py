@@ -57,6 +57,7 @@ import hashlib
 import json
 import os
 import pickle
+import threading
 import time
 
 from raft_tpu.obs import metrics
@@ -272,6 +273,155 @@ def _atomic_write(path, data):
     os.replace(tmp, path)
 
 
+# ------------------------------------------------------------ cost ledger
+
+#: key -> running cost/dispatch stats of every program this process
+#: loaded or compiled through the bank: the device-cost ledger behind
+#: ``obs report``'s per-program table, ``/healthz`` and the bench
+#: blocks.  Populated at load/store time from ``cost_analysis`` (the
+#: sidecar is authoritative for loads — a deserialized executable may
+#: refuse the query), updated per dispatch by :class:`BankedProgram`.
+#: Guarded by ``_STATS_LOCK``: the batcher tick thread mutates it while
+#: ``/healthz`` (asyncio thread) iterates ``ledger_summary``.
+PROGRAM_STATS: dict[str, dict] = {}
+_STATS_LOCK = threading.Lock()
+
+
+def cost_analysis_dict(compiled, args=None):
+    """Normalized ``compiled.cost_analysis()``: ``{"flops",
+    "bytes_accessed", "out_bytes", "transcendentals", "arg_bytes"}``
+    (numeric, finite; absent keys omitted).  ``{}`` when the backend
+    refuses the query — the ledger is telemetry, never a dispatch
+    gate."""
+    out = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        for src, dst in (("flops", "flops"),
+                         ("bytes accessed", "bytes_accessed"),
+                         ("bytes accessedout{}", "out_bytes"),
+                         ("transcendentals", "transcendentals")):
+            v = ca.get(src)
+            if isinstance(v, (int, float)) and v == v and v >= 0:
+                out[dst] = float(v)
+    except Exception:
+        pass
+    if args is not None:
+        try:
+            import jax
+            import numpy as np
+
+            out["arg_bytes"] = int(sum(
+                int(np.prod(getattr(x, "shape", ()) or (1,)))
+                * np.dtype(getattr(x, "dtype", type(x))).itemsize
+                for x in jax.tree_util.tree_leaves(args)))
+        except Exception:
+            pass
+    return out
+
+
+def record_cost(kind, key, cost, source):
+    """Fold one program's cost block into the in-process ledger and the
+    event stream (``program_cost``).  Idempotent per key."""
+    if not cost:
+        return
+    with _STATS_LOCK:
+        st = PROGRAM_STATS.setdefault(
+            key, {"kind": kind, "dispatches": 0, "wall_s": 0.0})
+        st.update(cost)
+    log_event("program_cost", kind=kind, key=key, source=source,
+              **{k: cost[k] for k in ("flops", "bytes_accessed",
+                                      "arg_bytes", "transcendentals")
+                 if k in cost})
+
+
+def record_dispatch(key, wall_s):
+    """One execution of a ledgered program: update its dispatch count /
+    wall totals and the process-wide achieved-GFLOP/s + utilization
+    metrics (vs ``RAFT_TPU_PEAK_TFLOPS``)."""
+    with _STATS_LOCK:
+        st = PROGRAM_STATS.get(key)
+        if st is None:
+            return
+        st["dispatches"] += 1
+        st["wall_s"] += wall_s
+        flops = st.get("flops")
+        kind = st.get("kind")
+    metrics.counter("program_dispatches").inc()
+    if not flops or wall_s <= 0:
+        return
+    gflops = flops / wall_s / 1e9
+    peak = float(config.get("PEAK_TFLOPS")) * 1e3  # GFLOP/s
+    util = gflops / peak if peak > 0 else None
+    metrics.histogram("program_gflops_s").observe(gflops)
+    if util is not None:
+        metrics.histogram("program_utilization").observe(util)
+    kw = {"gflops_s": round(gflops, 3)}
+    if util is not None:
+        kw["utilization"] = round(util, 6)
+    log_event("program_dispatch", key=key, kind=kind,
+              wall_s=round(wall_s, 6), **kw)
+
+
+def ledger_summary():
+    """JSON-ready per-program ledger rows (``/healthz``, the bench
+    serve/fabric blocks, fabric worker status files): key, kind, flops,
+    dispatches, and the dispatch-weighted mean achieved GFLOP/s."""
+    with _STATS_LOCK:
+        stats = {k: dict(v) for k, v in PROGRAM_STATS.items()}
+    rows = []
+    for key, st in sorted(stats.items()):
+        row = {"key": key, "kind": st.get("kind"),
+               "dispatches": st["dispatches"],
+               "wall_s": round(st["wall_s"], 4)}
+        for k in ("flops", "bytes_accessed", "arg_bytes", "out_bytes"):
+            if k in st:
+                row[k] = st[k]
+        flops = st.get("flops")
+        if flops and st["wall_s"] > 0 and st["dispatches"]:
+            # 6/9 decimals: toy/bench programs legitimately achieve
+            # micro-GFLOP/s rates that 3 decimals would round to 0
+            row["gflops_s_mean"] = round(
+                flops * st["dispatches"] / st["wall_s"] / 1e9, 6)
+            peak = float(config.get("PEAK_TFLOPS")) * 1e3
+            if peak > 0:
+                row["utilization_mean"] = round(
+                    row["gflops_s_mean"] / peak, 9)
+        rows.append(row)
+    return rows
+
+
+def merge_ledgers(row_lists):
+    """Fold several :func:`ledger_summary` row lists (e.g. every fabric
+    worker's published ledger) into one fleet-wide view: dispatches and
+    wall sum per key, the dispatch-weighted mean GFLOP/s recomputed.
+    Garbled rows are skipped — telemetry pooling must never crash."""
+    merged: dict[str, dict] = {}
+    for rows in row_lists:
+        for row in rows or ():
+            try:
+                key = row["key"]
+                m = merged.setdefault(
+                    key, {"key": key, "kind": row.get("kind"),
+                          "dispatches": 0, "wall_s": 0.0})
+                m["dispatches"] += int(row.get("dispatches") or 0)
+                m["wall_s"] += float(row.get("wall_s") or 0.0)
+                for k in ("flops", "bytes_accessed", "arg_bytes",
+                          "out_bytes"):
+                    if k in row:
+                        m[k] = row[k]
+            except (KeyError, TypeError, ValueError):
+                continue
+    for m in merged.values():
+        flops = m.get("flops")
+        if flops and m["wall_s"] > 0 and m["dispatches"]:
+            m["gflops_s_mean"] = round(
+                flops * m["dispatches"] / m["wall_s"] / 1e9, 6)
+        m["wall_s"] = round(m["wall_s"], 4)
+    return [merged[k] for k in sorted(merged)]
+
+
 # ------------------------------------------------------------------ load/store
 
 _NATIVE_CALLBACKS_ARMED = [False]
@@ -345,6 +495,13 @@ def lookup(kind, memo_key, args):
     metrics.counter("aot_programs_loaded").inc()
     log_event("aot_load", kind=kind, key=key, bytes=len(buf),
               wall_s=round(wall, 4))
+    # the sidecar's cost block is authoritative (recorded at export);
+    # entries predating the ledger fall back to querying the
+    # deserialized executable, which may refuse — then no ledger row
+    record_cost(kind, key,
+                meta.get("cost_analysis") or cost_analysis_dict(compiled,
+                                                                args),
+                source="load")
     return compiled
 
 
@@ -380,13 +537,15 @@ def _compile_fresh(lowered):
         compilation_cache.reset_cache()
 
 
-def store(kind, memo_key, args, lowered, compiled, compile_s):
+def store(kind, memo_key, args, lowered, compiled, compile_s, cost=None):
     """Export a freshly-compiled executable into the bank (best
     effort: serialization failures are logged, never fatal).  The
     ``.bin`` payload lands before its ``.json`` sidecar — the loader
     requires both, so a crash between the writes leaves an orphan the
     ``gc``/``verify`` CLIs surface, not a half-entry that loads."""
     key, meta = entry_key(kind, memo_key, args)
+    if cost is None:
+        cost = cost_analysis_dict(compiled, args)
     try:
         from jax.experimental import serialize_executable
 
@@ -404,6 +563,7 @@ def store(kind, memo_key, args, lowered, compiled, compile_s):
                     payload_bytes=len(buf),
                     stablehlo_sha256=hlo_hash,
                     compile_s=round(float(compile_s), 3),
+                    cost_analysis=cost,
                     created=time.time(),
                     raft_flags={k: config.get(k) for k in
                                 ("SOLVER", "FIXED_POINT", "SCAN_CHUNK",
@@ -466,8 +626,11 @@ def compile_or_load(fn, args, kind, memo_key=(), bankable=True):
     compiled = _compile_fresh(lowered) if m != "off" else lowered.compile()
     dt = time.perf_counter() - t0
     metrics.counter("aot_programs_compiled").inc()
+    cost = cost_analysis_dict(compiled, args)
+    key, _ = entry_key(kind, memo_key, args)
+    record_cost(kind, key, cost, source="compile")
     if m != "off":
-        store(kind, memo_key, args, lowered, compiled, dt)
+        store(kind, memo_key, args, lowered, compiled, dt, cost=cost)
     return compiled, False, dt
 
 
@@ -510,12 +673,25 @@ class BankedProgram:
                 log_event("aot_unbankable", kind=self._kind)
             return self._jit()(*args)
         sig = _aval_sig(args)
-        exe = self._execs.get(sig)
-        if exe is None:
+        ent = self._execs.get(sig)
+        if ent is None:
             exe, _, _ = compile_or_load(self._jit(), args,
                                         self._kind, self._memo_key)
-            self._execs[sig] = exe
-        return exe(*args)
+            key, _ = entry_key(self._kind, self._memo_key, args)
+            ent = self._execs[sig] = (exe, key)
+        exe, key = ent
+        if key not in PROGRAM_STATS:
+            return exe(*args)
+        # cost-ledgered dispatch: block before reading the clock so the
+        # achieved GFLOP/s is real execution, not async dispatch (the
+        # callers all np.asarray the outputs right after anyway)
+        import jax
+
+        t0 = time.perf_counter()
+        out = exe(*args)
+        jax.block_until_ready(out)
+        record_dispatch(key, time.perf_counter() - t0)
+        return out
 
 
 # ------------------------------------------------------- bank maintenance

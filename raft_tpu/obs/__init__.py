@@ -31,5 +31,6 @@ backend-free); jax access inside heartbeat/spans is lazy and gated.
 
 from raft_tpu.obs import events, metrics  # noqa: F401
 from raft_tpu.obs.heartbeat import Heartbeat, maybe_heartbeat  # noqa: F401
-from raft_tpu.obs.spans import current_ids, span  # noqa: F401
+from raft_tpu.obs.spans import (current_ids, format_traceparent,  # noqa: F401
+                                parse_traceparent, propagation_env, span)
 from raft_tpu.utils.structlog import run_id  # noqa: F401

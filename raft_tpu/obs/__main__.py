@@ -1,14 +1,24 @@
 """CLI for the telemetry subsystem (pure stdlib, no jax).
 
     python -m raft_tpu.obs report <run.jsonl>
+    python -m raft_tpu.obs report --merge <capture-dir | shard.jsonl ...>
     python -m raft_tpu.obs trace  <run.jsonl> -o trace.json
+    python -m raft_tpu.obs trace  --merge <capture-dir | shards...> -o t.json
     python -m raft_tpu.obs events
+    python -m raft_tpu.obs spans
 
-``report`` prints the per-stage wall-time tree, counter table and
-reliability summary of one ``RAFT_TPU_LOG`` capture; ``trace`` exports
-it as Chrome/Perfetto trace-event JSON (load in ``chrome://tracing``
-or https://ui.perfetto.dev); ``events`` lists the registered event
-schema.  Exit codes: 0 ok, 2 usage/input error.
+``report`` prints the per-stage wall-time tree, counter table, program
+cost ledger and reliability summary of one ``RAFT_TPU_LOG`` capture;
+``trace`` exports it as Chrome/Perfetto trace-event JSON (load in
+``chrome://tracing`` or https://ui.perfetto.dev).  ``--merge`` accepts
+several per-process capture shards (or a directory of
+``trace-<pid>.jsonl`` files, the ``RAFT_TPU_LOG=<dir>`` layout) and
+assembles coordinator + workers + server onto ONE wall-clock timeline
+using the per-process ``proc_start`` clock anchors; ``--check`` (trace)
+additionally exits 1 when the merged capture has unmatched span begins
+or orphan spans (a parent id resolving to no span) — the cross-process
+propagation acceptance gate.  ``events``/``spans`` list the registered
+schemas.  Exit codes: 0 ok, 1 check failed, 2 usage/input error.
 """
 
 from __future__ import annotations
@@ -18,43 +28,62 @@ import json
 import sys
 
 
-def _load(path):
+def _load(paths, merge):
     from raft_tpu.obs import report
 
     try:
-        events, bad = report.read_events(path)
+        if merge:
+            events, bad, info = report.merge_captures(paths)
+        else:
+            if len(paths) != 1:
+                print("multiple captures need --merge", file=sys.stderr)
+                raise SystemExit(2)
+            events, bad = report.read_events(paths[0])
+            info = None
     except OSError as e:
-        print(f"cannot read {path}: {e}", file=sys.stderr)
+        print(f"cannot read {getattr(e, 'filename', None) or paths}: {e}",
+              file=sys.stderr)
         raise SystemExit(2)
     if not events:
-        print(f"{path}: no parseable events (was RAFT_TPU_LOG pointed "
-              "here during the run?)", file=sys.stderr)
+        print(f"{', '.join(paths)}: no parseable events (was RAFT_TPU_LOG "
+              "pointed here during the run?)", file=sys.stderr)
         raise SystemExit(2)
-    return events, bad
+    return events, bad, info
 
 
 def _cmd_report(args):
     from raft_tpu.obs import report
 
-    events, bad = _load(args.jsonl)
-    sys.stdout.write(report.render_report(events, bad, source=args.jsonl))
+    events, bad, _ = _load(args.jsonl, args.merge)
+    sys.stdout.write(report.render_report(
+        events, bad, source=", ".join(args.jsonl)))
     return 0
 
 
 def _cmd_trace(args):
     from raft_tpu.obs import report
 
-    events, bad = _load(args.jsonl)
-    trace = report.chrome_trace(events)
+    events, bad, info = _load(args.jsonl, args.merge)
+    trace = report.chrome_trace(events, merged=args.merge)
     with open(args.output, "w") as f:
         json.dump(trace, f)
     meta = trace["otherData"]
     print(f"{args.output}: {len(trace['traceEvents'])} trace events "
-          f"({meta['spans_matched']} spans"
+          f"({meta['spans_matched']} spans across {meta['pids']} "
+          f"process(es), {meta['traces']} trace id(s)"
           + (f", {meta['spans_unmatched']} unmatched" if
              meta["spans_unmatched"] else "")
+          + (f", {meta['spans_orphaned']} orphaned" if
+             meta["spans_orphaned"] else "")
+          + (f"; {info['unanchored_files']} unanchored shard(s)"
+             if info and info.get("unanchored_files") else "")
           + (f"; {bad} unparseable lines skipped" if bad else "")
           + ") — open in chrome://tracing or ui.perfetto.dev")
+    if args.check and (meta["spans_unmatched"] or meta["spans_orphaned"]):
+        print(f"check FAILED: {meta['spans_unmatched']} unmatched begin(s), "
+              f"{meta['spans_orphaned']} orphan span(s) — cross-process "
+              "propagation is broken somewhere", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -66,24 +95,46 @@ def _cmd_events(_args):
     return 0
 
 
+def _cmd_spans(_args):
+    from raft_tpu.obs import events as ev
+
+    for name, help_ in ev.describe_spans():
+        print(f"{name:32s} {help_}")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="python -m raft_tpu.obs")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("report", help="summarize one RAFT_TPU_LOG capture")
-    p.add_argument("jsonl", help="path to the captured JSONL event stream")
+    p.add_argument("jsonl", nargs="+",
+                   help="captured JSONL stream(s), or a capture directory "
+                        "with --merge")
+    p.add_argument("--merge", action="store_true",
+                   help="assemble several per-process shards onto one "
+                        "wall-clock timeline (proc_start anchors)")
 
     p = sub.add_parser("trace",
                        help="export a capture as Chrome trace events")
-    p.add_argument("jsonl", help="path to the captured JSONL event stream")
+    p.add_argument("jsonl", nargs="+",
+                   help="captured JSONL stream(s), or a capture directory "
+                        "with --merge")
     p.add_argument("-o", "--output", default="trace.json",
                    help="output path (default trace.json)")
+    p.add_argument("--merge", action="store_true",
+                   help="assemble several per-process shards onto one "
+                        "wall-clock timeline (proc_start anchors)")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 on unmatched or orphan spans (CI gate "
+                        "for cross-process trace propagation)")
 
     sub.add_parser("events", help="list the registered event schema")
+    sub.add_parser("spans", help="list the registered span names")
 
     args = ap.parse_args(argv)
     return {"report": _cmd_report, "trace": _cmd_trace,
-            "events": _cmd_events}[args.cmd](args)
+            "events": _cmd_events, "spans": _cmd_spans}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -24,17 +24,26 @@ from __future__ import annotations
 #: payload keys; optional keys are suffixed with ``?``.
 EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
     # ------------------------------------------------------------ telemetry
+    "proc_start": (
+        ("unix_t", "argv0"),
+        "per-(process, sink) clock anchor: unix_t is the wall-clock "
+        "instant of this record's monotonic t, letting `obs trace "
+        "--merge` normalize every process onto one shared timeline"),
     "span_begin": (
-        ("name", "parent_id"),
-        "a telemetry span opened (obs.span); attrs ride along verbatim"),
+        ("name", "parent_id", "remote_parent?", "links?"),
+        "a telemetry span opened (obs.span); attrs ride along verbatim "
+        "(remote_parent marks a root adopted from traceparent "
+        "propagation; links name coalesced request spans)"),
     "span_end": (
         ("name", "wall_s", "ok", "error?"),
         "the matching span closed; error carries repr(exc) on failure"),
     "heartbeat": (
-        ("devices", "live_arrays", "progress?", "worker_id?", "leases?"),
+        ("devices", "live_arrays", "progress?", "worker_id?", "leases?",
+         "windows?"),
         "periodic device sampler: per-device memory_stats, live-buffer "
         "count, sweep shard progress (RAFT_TPU_HEARTBEAT_S); fabric "
-        "workers add their id and currently-held shard leases"),
+        "workers add their id and currently-held shard leases; serving "
+        "processes add the sliding-window latency snapshots"),
     "metrics_snapshot": (
         ("snapshot",),
         "full metrics-registry snapshot (emitted at sweep_done; also "
@@ -191,6 +200,11 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
     "serve_drain": (
         ("pending", "wall_s", "completed"),
         "graceful drain: new work refused, pending ticks finished"),
+    "slo_breach": (
+        ("wall_s", "slo_ms", "client?", "cache_hit?"),
+        "one request resolved slower than RAFT_TPU_SERVE_SLO_MS "
+        "(counted in serve_slo_breaches; /healthz reports both next "
+        "to the sliding-window p50/p95)"),
     "serve_stop": (
         ("requests", "wall_s"),
         "the service exited after draining and flushing metrics"),
@@ -229,7 +243,51 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         ("count", "budget", "action"),
         "a backend compilation exceeded RAFT_TPU_COMPILE_BUDGET; "
         "action 'error' raised RecompilationError at the dispatch"),
+    # -------------------------------------------------- device-cost ledger
+    "program_cost": (
+        ("kind", "key", "source", "flops?", "bytes_accessed?",
+         "arg_bytes?", "transcendentals?"),
+        "XLA cost_analysis of one banked/compiled program (source: "
+        "store | load | compile) — the per-program entry of the "
+        "device-cost ledger, persisted in the bank's .json sidecar"),
+    "program_dispatch": (
+        ("key", "kind", "wall_s", "gflops_s?", "utilization?"),
+        "one bank-fronted program execution with its achieved GFLOP/s "
+        "and fraction of RAFT_TPU_PEAK_TFLOPS (wall includes "
+        "block-until-ready, so the rate is honest, not async-deflated)"),
 }
+
+#: Span-name registry, mirroring EVENTS for the names used with
+#: ``obs.span(...)``: a typo'd span name silently forks the wall-time
+#: tree (and mints a stray ``span_<name>_s`` histogram) instead of
+#: crashing — the ``span-name`` lint rule holds call sites to this
+#: table.  name -> help.
+SPANS: dict[str, str] = {
+    "driver.run": "one full analysis via raft_tpu.drivers.run",
+    "driver.run_farm": "one farm analysis via raft_tpu.drivers.run_farm",
+    "solve_statics": "per-case statics equilibrium solve",
+    "solve_dynamics": "per-case dynamics (drag-linearised) solve",
+    "sweep": "one checkpointed/fabric sweep, root of the shard tree",
+    "shard": "one shard's fault-tolerant evaluation",
+    "shard_attempt": "one retry attempt inside a shard",
+    "escalation_rung": "one escalation-ladder re-solve of a flagged row",
+    "sweep_dispatch": "one compiled-program dispatch (cases/full/bucket/"
+                      "serve)",
+    "serve_request": "one /evaluate request, HTTP accept to response "
+                     "(adopts the client's traceparent when sent)",
+    "serve_tick": "one non-empty batcher tick; `links` names every "
+                  "coalesced request span it dispatched for",
+}
+
+
+def is_registered_span(name):
+    return name in SPANS
+
+
+def describe_spans():
+    """Yield ``(name, help)`` rows sorted by name (README span table)."""
+    for name in sorted(SPANS):
+        yield name, SPANS[name]
 
 
 def is_registered(name):

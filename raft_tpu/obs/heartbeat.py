@@ -101,6 +101,15 @@ class Heartbeat(threading.Thread):
         if live is not None:
             metrics.gauge("live_arrays").set(live)
         kw = {}
+        # same window length the live /healthz endpoint reports, so a
+        # captured beat and a concurrent scrape agree on the SLO view
+        wins = metrics.sample_windows(
+            float(config.get("SERVE_WINDOW_S") or 0) or None)
+        if wins:
+            # sliding-window time series (serve request latency): each
+            # beat carries the last-N-seconds p50/p95/rate, so a capture
+            # shows the SLO view over time, not just the final state
+            kw["windows"] = wins
         if self.progress:
             kw["progress"] = dict(self.progress)
         if self.worker_id is not None:

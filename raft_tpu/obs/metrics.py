@@ -28,6 +28,7 @@ from __future__ import annotations
 import bisect
 import threading
 import time
+from collections import deque
 
 _T0 = time.perf_counter()
 
@@ -219,6 +220,68 @@ class Histogram:
                     self._buckets[i] += n
 
 
+class Window:
+    """Sliding-time-window series: a bounded ring buffer of
+    ``(t, value)`` samples answering "p50/p95/rate over the last N
+    seconds" — the SLO view a process-lifetime histogram cannot give
+    (an always-on server's lifetime p95 hides the last minute's
+    regression).  Percentiles are EXACT over the in-window samples
+    (nearest-rank), not bucket estimates; the ring bound
+    (``maxlen``) caps memory, so under sustained load the window may
+    effectively shrink below ``window_s`` — honest for an SLO view,
+    which cares about the most recent samples anyway."""
+
+    DEFAULT_WINDOW_S = 60.0
+
+    __slots__ = ("name", "_lock", "_buf", "total")
+
+    def __init__(self, name, maxlen=4096):
+        self.name = name
+        self._lock = threading.Lock()
+        self._buf = deque(maxlen=int(maxlen))
+        self.total = 0  # lifetime observation count (ring drops old)
+
+    def observe(self, v, t=None):
+        t = time.perf_counter() if t is None else float(t)
+        with self._lock:
+            self._buf.append((t, float(v)))
+            self.total += 1
+
+    def values(self, window_s=None, now=None):
+        """In-window sample values, oldest first."""
+        window_s = self.DEFAULT_WINDOW_S if window_s is None else window_s
+        now = time.perf_counter() if now is None else float(now)
+        with self._lock:
+            return [v for t, v in self._buf if now - t <= window_s]
+
+    @staticmethod
+    def _nearest_rank(sorted_vals, p):
+        i = min(len(sorted_vals) - 1,
+                max(0, round(p * (len(sorted_vals) - 1))))
+        return sorted_vals[i]
+
+    def percentile(self, p, window_s=None, now=None):
+        """Exact nearest-rank p-quantile (0..1) over the window, or
+        None when the window holds no samples."""
+        vals = sorted(self.values(window_s, now))
+        return self._nearest_rank(vals, p) if vals else None
+
+    def snapshot(self, window_s=None, now=None):
+        window_s = self.DEFAULT_WINDOW_S if window_s is None else window_s
+        vals = sorted(self.values(window_s, now))
+        if not vals:
+            return {"count": 0, "window_s": window_s, "total": self.total}
+        return {
+            "count": len(vals),
+            "window_s": window_s,
+            "total": self.total,
+            "rate_per_s": round(len(vals) / window_s, 4),
+            "p50": round(self._nearest_rank(vals, 0.50), 6),
+            "p95": round(self._nearest_rank(vals, 0.95), 6),
+            "max": round(vals[-1], 6),
+        }
+
+
 _REGISTRY_LOCK = threading.Lock()
 _REGISTRY: dict[str, object] = {}
 
@@ -247,6 +310,20 @@ def histogram(name) -> Histogram:
     return _get(name, Histogram)
 
 
+def window(name) -> Window:
+    return _get(name, Window)
+
+
+def sample_windows(window_s=None):
+    """``{name: snapshot}`` of every registered window — the heartbeat
+    embeds this in each ``heartbeat`` event's ``windows`` payload so a
+    capture shows the sliding p50/p95/rate view over time."""
+    with _REGISTRY_LOCK:
+        items = [(n, m) for n, m in sorted(_REGISTRY.items())
+                 if isinstance(m, Window)]
+    return {n: m.snapshot(window_s) for n, m in items}
+
+
 def merge_states(states, name="merged"):
     """Pool several :meth:`Histogram.state` dicts into one fresh
     (unregistered) histogram — the fabric's fleet-wide ``shard_wall_s``
@@ -273,11 +350,13 @@ def snapshot():
     with _REGISTRY_LOCK:
         items = sorted(_REGISTRY.items())
     out = {"uptime_s": round(time.perf_counter() - _T0, 3),
-           "counters": {}, "gauges": {}, "histograms": {}}
+           "counters": {}, "gauges": {}, "histograms": {}, "windows": {}}
     for name, m in items:
         kind = {Counter: "counters", Gauge: "gauges",
-                Histogram: "histograms"}[type(m)]
+                Histogram: "histograms", Window: "windows"}[type(m)]
         out[kind][name] = m.snapshot()
+    if not out["windows"]:
+        del out["windows"]  # snapshot schema unchanged for non-serving
     return out
 
 
@@ -304,6 +383,13 @@ def to_prometheus():
             lines.append(f"# TYPE {pn} gauge")
             lines.append(f"{pn} {m.value}")
             lines.append(f"{pn}_max {m.max}")
+        elif isinstance(m, Window):
+            snap = m.snapshot()
+            if not snap["count"]:
+                continue
+            lines.append(f"# TYPE {pn} gauge")
+            for k in ("p50", "p95", "max", "count", "rate_per_s"):
+                lines.append(f"{pn}_{k} {snap[k]}")
         else:
             lines.append(f"# TYPE {pn} histogram")
             last_nonzero = 0

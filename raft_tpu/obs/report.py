@@ -19,6 +19,83 @@ Two consumers of a ``RAFT_TPU_LOG`` capture (pure stdlib, no jax):
 from __future__ import annotations
 
 import json
+import os
+
+
+def expand_captures(paths):
+    """Flatten capture arguments: a directory expands to its sorted
+    ``*.jsonl`` shards (the per-process ``RAFT_TPU_LOG=<dir>`` layout),
+    a file stands for itself."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out += [os.path.join(p, n) for n in sorted(os.listdir(p))
+                    if n.endswith(".jsonl")]
+        else:
+            out.append(p)
+    return out
+
+
+def merge_captures(paths):
+    """Assemble several per-process captures into ONE event list on a
+    shared wall-clock timeline.
+
+    Every process's ``t`` is monotonic since ITS OWN start; the
+    ``proc_start`` clock anchors (emitted as each sink's first record)
+    carry ``unix_t``, so an anchored event maps to
+    ``unix_t + (t - t_anchor)``.  Files without an anchor (captures
+    predating the anchor, or truncated heads) are laid out sequentially
+    AFTER the anchored window — visible, just not aligned.  Returns
+    ``(events, n_bad, info)``; the returned events carry normalized
+    ``t`` (seconds from the earliest anchored instant) and sort by it.
+    """
+    per_file = []
+    n_bad = 0
+    walls = []
+    for path in expand_captures(paths):
+        events, bad = read_events(path)
+        n_bad += bad
+        if not events:
+            continue
+        # segment by anchor: a pid-reused shard file can hold several
+        # process lifetimes, each opening with its own proc_start
+        anchor = None
+        rows = []
+        for ev in events:
+            if ev["event"] == "proc_start" and "unix_t" in ev:
+                anchor = (ev["t"], float(ev["unix_t"]))
+            wall = (anchor[1] + (ev["t"] - anchor[0])
+                    if anchor is not None else None)
+            rows.append((wall, ev))
+            if wall is not None:
+                walls.append(wall)
+        per_file.append((path, rows))
+    t0 = min(walls) if walls else 0.0
+    merged = []
+    n_unanchored_files = 0
+    cursor = (max(walls) - t0 + 1e-3) if walls else 0.0
+    for path, rows in per_file:
+        unanchored = [ev for wall, ev in rows if wall is None]
+        if unanchored:
+            n_unanchored_files += 1
+            lo = min(ev["t"] for ev in unanchored)
+            hi = max(ev["t"] for ev in unanchored)
+            for ev in unanchored:
+                ev = dict(ev)
+                ev["t"] = round(cursor + (ev["t"] - lo), 6)
+                merged.append(ev)
+            cursor += (hi - lo) + 1e-3
+        for wall, ev in rows:
+            if wall is None:
+                continue
+            ev = dict(ev)
+            ev["t"] = round(wall - t0, 6)
+            merged.append(ev)
+    merged.sort(key=lambda e: e["t"])
+    info = {"files": len(per_file),
+            "unanchored_files": n_unanchored_files,
+            "t0_unix": round(t0, 6) if walls else None}
+    return merged, n_bad, info
 
 
 def read_events(path):
@@ -261,6 +338,60 @@ def render_report(events, n_bad=0, source="<events>"):
                 f"mean batch {sum(rows) / len(ticks):.1f}, "
                 f"tick p95 {_percentile(walls, 0.95):.3f}s)")
 
+    # device-cost ledger: one row per banked/compiled program, joined
+    # from program_cost (flops, at load/store) and program_dispatch
+    # (wall + achieved rate, per execution).  The "effective" column
+    # adjusts achieved GFLOP/s for padding waste — flops spent on
+    # masked pad rows are real device work but not useful evals — using
+    # the capture's mean batch occupancy (serve) or 1 - padding waste
+    # (bucketed sweeps) when either is present.
+    progs = {}
+    for e in events:
+        if e["event"] == "program_cost" and e.get("key"):
+            rec = progs.setdefault(e["key"], {"dispatches": 0,
+                                              "wall_s": 0.0})
+            rec["kind"] = e.get("kind")
+            if e.get("flops") is not None:
+                rec["flops"] = e["flops"]
+        elif e["event"] == "program_dispatch" and e.get("key"):
+            rec = progs.setdefault(e["key"], {"dispatches": 0,
+                                              "wall_s": 0.0})
+            rec.setdefault("kind", e.get("kind"))
+            rec["dispatches"] += 1
+            rec["wall_s"] += e.get("wall_s") or 0.0
+    if progs:
+        occupancy = None
+        if snaps:
+            occ = (snaps[-1].get("snapshot", {}).get("histograms", {})
+                   .get("serve_batch_occupancy") or {})
+            occupancy = occ.get("mean")
+        if occupancy is None:
+            wastes = [e["padding_waste_frac"] for e in events
+                      if e["event"] == "bucket_sweep"
+                      and e.get("padding_waste_frac") is not None]
+            if wastes:
+                occupancy = 1.0 - sum(wastes) / len(wastes)
+        out.append("")
+        out.append("program cost ledger (key / kind / GFLOP / dispatches "
+                   "/ achieved GFLOP/s / effective)")
+        for key in sorted(progs):
+            rec = progs[key]
+            flops = rec.get("flops")
+            gflops = (flops * rec["dispatches"] / rec["wall_s"] / 1e9
+                      if flops and rec["wall_s"] > 0 and rec["dispatches"]
+                      else None)
+            eff = (gflops * occupancy
+                   if gflops is not None and occupancy is not None else None)
+            out.append(
+                f"  {key:26s} {str(rec.get('kind') or '?'):12s} "
+                + (f"{flops / 1e9:10.3f}" if flops else "         —")
+                + f" {rec['dispatches']:6d} "
+                + (f"{gflops:10.2f}" if gflops is not None else "         —")
+                + (f" {eff:10.2f}" if eff is not None else "          —"))
+        if occupancy is not None:
+            out.append(f"  (effective = achieved x mean batch occupancy "
+                       f"{occupancy:.3f})")
+
     counts = {}
     for e in events:
         counts[e["event"]] = counts.get(e["event"], 0) + 1
@@ -332,14 +463,17 @@ def _pid_time_offsets(events):
     return offsets
 
 
-def chrome_trace(events):
+def chrome_trace(events, merged=False):
     """Chrome trace-event JSON (dict with ``traceEvents``) from one
     capture: matched spans as complete "X" slices, other events as
     instants, heartbeat memory samples as counter tracks.  Multi-pid
     captures (resume appends) render sequentially, one process track
-    after the other."""
+    after the other — EXCEPT under ``merged=True``
+    (:func:`merge_captures` already normalized every process onto one
+    wall clock, so timestamps are used as-is and concurrent processes
+    genuinely overlap on the timeline)."""
     spans, unmatched = collect_spans(events)
-    offsets = _pid_time_offsets(events)
+    offsets = {} if merged else _pid_time_offsets(events)
     tids = {}
 
     def tid_for(trace_id):
@@ -393,8 +527,33 @@ def chrome_trace(events):
                              "trace_id", "span_id")}
         trace.append({"name": kind, "cat": "event", "ph": "i", "s": "p",
                       "ts": ts, "pid": pid, "tid": tid, "args": args})
+    # orphans: spans whose parent_id resolves to no span in the capture
+    # — in a properly-propagated multi-process merge every worker root
+    # chains to the coordinator's sweep span and every serve dispatch
+    # to its tick, so the merged count must be 0 (the acceptance gate
+    # `obs trace --merge --check` enforces).  Exception: a span whose
+    # parent came from an EXTERNAL tracer (remote_parent, e.g. a traced
+    # HTTP client sending `traceparent`) when no other process in the
+    # capture contributed to its trace — that parent legitimately lives
+    # in the client's telemetry, not ours.
+    ids = {s["span_id"] for s in spans} | {b.get("span_id")
+                                           for b in unmatched}
+    pids_by_trace: dict = {}
+    for s in spans:
+        pids_by_trace.setdefault(s["trace_id"], set()).add(s.get("pid"))
+    orphans = []
+    for s in spans:
+        if not s["parent_id"] or s["parent_id"] in ids:
+            continue
+        if s["attrs"].get("remote_parent") and \
+                len(pids_by_trace.get(s["trace_id"], ())) <= 1:
+            continue
+        orphans.append(s)
     meta = {"spans_matched": len(spans),
             "spans_unmatched": len(unmatched),
+            "spans_orphaned": len(orphans),
+            "traces": len({s["trace_id"] for s in spans if s["trace_id"]}),
+            "pids": len({e.get("pid") or 1 for e in events}),
             "run_ids": sorted({e.get("run_id") for e in events
                                if e.get("run_id")})}
     return {"traceEvents": trace, "displayTimeUnit": "ms",

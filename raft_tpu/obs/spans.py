@@ -29,6 +29,7 @@ traced programs carry the same names down onto device ops).
 
 from __future__ import annotations
 
+import re
 import time
 import uuid
 
@@ -45,6 +46,82 @@ def current_ids():
     return structlog.SPAN_CTX.get()
 
 
+# ------------------------------------------------- cross-process propagation
+
+#: W3C trace-context `traceparent`: version "00", 32-hex trace id,
+#: 16-hex parent span id, 2-hex flags.  Lenient on trace-id length
+#: (internal ids are 16 hex; foreign tracers send 32).
+_TRACEPARENT_RE = re.compile(
+    r"^\s*([0-9a-f]{2})-([0-9a-f]{16,32})-([0-9a-f]{16})-([0-9a-f]{2})\s*$")
+
+
+def parse_traceparent(header):
+    """``(trace_id, parent_span_id)`` from one ``traceparent`` header
+    (or the ``RAFT_TPU_TRACEPARENT`` env value), else None.  The trace
+    id keeps whatever meaningful hex the sender used (leading zero
+    padding from :func:`format_traceparent` is stripped back off so a
+    round trip is identity for internal 16-hex ids)."""
+    if not header or not isinstance(header, str):
+        return None
+    m = _TRACEPARENT_RE.match(header.lower())
+    if not m:
+        return None
+    trace_id, span_id = m.group(2), m.group(3)
+    if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+        return None  # all-zero ids are "no trace" per the W3C spec
+    stripped = trace_id.lstrip("0")
+    if len(trace_id) == 32 and len(stripped) <= 16:
+        trace_id = stripped.rjust(16, "0")
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id=None, span_id=None):
+    """The ``traceparent`` header/env value for (trace_id, span_id) —
+    default: the innermost active span of this task/thread.  None when
+    there is no active span (nothing to propagate)."""
+    if trace_id is None or span_id is None:
+        ctx = structlog.SPAN_CTX.get()
+        if ctx is None:
+            return None
+        trace_id, span_id = ctx
+    return f"00-{str(trace_id).rjust(32, '0')}-{str(span_id).rjust(16, '0')}-01"
+
+
+def remote_context():
+    """The trace context inherited from a parent process
+    (``RAFT_TPU_TRACEPARENT``), parsed, or None.  A process's first
+    root span joins this trace instead of minting a fresh trace_id —
+    which is what stitches fabric workers (and anything else spawned
+    with :func:`propagation_env`) into the coordinator's timeline."""
+    return parse_traceparent(config.raw("TRACEPARENT"))
+
+
+def ambient_ids():
+    """(trace_id, span_id-or-parent) for stamping cross-process
+    records (fabric lease/done files): the active span's ids when
+    inside one, else the inherited remote context, else None."""
+    ctx = structlog.SPAN_CTX.get()
+    if ctx is not None:
+        return ctx
+    return remote_context()
+
+
+def propagation_env():
+    """Env vars that stitch a child process into this one's telemetry:
+    always the run id (a worker minting its own uuid is exactly the
+    split-timeline bug this exists to prevent), plus the traceparent
+    when called inside an active span."""
+    env = {config.env_name("RUN_ID"): structlog.run_id()}
+    tp = format_traceparent()
+    if tp is None:
+        # no active span (e.g. logging off in the parent): still
+        # forward any context *we* inherited, so a grandchild chains
+        tp = config.raw("TRACEPARENT") or None
+    if tp:
+        env[config.env_name("TRACEPARENT")] = tp
+    return env
+
+
 class span:
     """Context manager for one telemetry span::
 
@@ -57,9 +134,14 @@ class span:
     Exceptions always propagate."""
 
     __slots__ = ("name", "attrs", "trace_id", "span_id",
-                 "_token", "_t0", "_ann")
+                 "_token", "_t0", "_ann", "_remote")
 
-    def __init__(self, name, **attrs):
+    def __init__(self, name, remote=None, **attrs):
+        """``remote=(trace_id, parent_span_id)`` adopts an explicit
+        cross-process parent (e.g. a parsed HTTP ``traceparent``) for a
+        ROOT span; a nested span always keeps its in-process parent.
+        With no explicit remote, a root span consults
+        ``RAFT_TPU_TRACEPARENT`` (:func:`remote_context`)."""
         self.name = name
         self.attrs = attrs
         self.trace_id = None
@@ -67,6 +149,7 @@ class span:
         self._token = None
         self._t0 = None
         self._ann = None
+        self._remote = remote
 
     def __enter__(self):
         if config.raw("PROFILE"):
@@ -83,12 +166,22 @@ class span:
         if not structlog.enabled():
             return self  # fast path: no ids, no contextvar, no event
         parent = structlog.SPAN_CTX.get()
+        kw = {}
+        if parent is None:
+            # root span: adopt a cross-process parent — an explicit one
+            # (HTTP traceparent) first, else the env-inherited context a
+            # coordinator pinned into this process (fabric workers) —
+            # so the whole fleet shares ONE trace instead of N
+            remote = self._remote or remote_context()
+            if remote is not None:
+                parent = remote
+                kw["remote_parent"] = True
         self.trace_id = parent[0] if parent else _new_id()
         self.span_id = _new_id()
         self._token = structlog.SPAN_CTX.set((self.trace_id, self.span_id))
         structlog.log_event(
             "span_begin", name=self.name,
-            parent_id=parent[1] if parent else None, **self.attrs)
+            parent_id=parent[1] if parent else None, **kw, **self.attrs)
         return self
 
     def __exit__(self, exc_type, exc, tb):

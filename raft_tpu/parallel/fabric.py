@@ -87,7 +87,7 @@ import numpy as np
 
 from raft_tpu.obs import metrics
 from raft_tpu.obs.heartbeat import maybe_heartbeat
-from raft_tpu.obs.spans import span
+from raft_tpu.obs.spans import ambient_ids, propagation_env, span
 from raft_tpu.parallel import resilience
 from raft_tpu.utils import config, faults
 from raft_tpu.utils.structlog import log_event
@@ -227,6 +227,9 @@ class Ledger:
             "attempt": int(attempt),
             "token": self.token,
         }
+        ids = ambient_ids()  # active span or env-inherited trace ctx
+        if ids is not None:
+            rec["trace_id"], rec["parent_span_id"] = ids
         with os.fdopen(fd, "w") as f:
             json.dump(rec, f)
         metrics.counter("shards_claimed").inc()
@@ -330,6 +333,9 @@ class Ledger:
     def write_done(self, shard, **rec):
         rec.setdefault("worker", self.worker_id)
         rec.setdefault("t", time.time())
+        ids = ambient_ids()
+        if ids is not None and "trace_id" not in rec:
+            rec["trace_id"], rec["parent_span_id"] = ids
         resilience._atomic_json(_done_path(self.out_dir, shard), rec)
 
     def done_count(self):
@@ -617,14 +623,20 @@ class Worker:
                 progress["shards_done"] = self.shards_done
 
         cnt = metrics.snapshot()["counters"]
+        from raft_tpu.aot import bank
+
         # warmup/AOT activity predates counters0 — report absolutes for
-        # the program provenance, deltas for the sweep bookkeeping
+        # the program provenance, deltas for the sweep bookkeeping;
+        # `programs` is this worker's device-cost ledger (per-program
+        # flops/dispatches/achieved GFLOP/s), folded fleet-wide by the
+        # coordinator's assemble and the bench fabric block
         self.ledger.write_worker_status(
             "done", counters=self._counter_delta(),
             shards_done=self.shards_done,
             shards_resumed=self.shards_resumed, rows=self.rows,
             programs_loaded=cnt.get("aot_programs_loaded", 0),
-            programs_compiled=cnt.get("aot_programs_compiled", 0))
+            programs_compiled=cnt.get("aot_programs_compiled", 0),
+            programs=bank.ledger_summary())
         log_event("fabric_worker_done", out_dir=self.out_dir,
                   worker=self.worker_id, shards_done=self.shards_done,
                   shards_resumed=self.shards_resumed, rows=self.rows,
@@ -856,6 +868,12 @@ def spawn_worker(out_dir, index=0, worker_id=None, env=None,
     wid = worker_id or f"w{index}"
     wenv = dict(os.environ)
     wenv.update(_worker_device_env(index, int(workers_total)))
+    # telemetry linkage (the 5-unlinked-timelines bug): pin the
+    # coordinator's run id into every worker so their structlog records
+    # and heartbeats join the parent run instead of minting fresh
+    # uuids, and hand them the enclosing sweep span as traceparent so
+    # worker shard spans resolve into the coordinator's trace
+    wenv.update(propagation_env())
     wenv.update(env or {})
     wenv[config.env_name("WORKER_ID")] = wid
     root = _repo_root()
@@ -1036,6 +1054,8 @@ def assemble(out_dir, spec=None, wall_s=None):
     for k, v in counters.items():
         metrics.counter(k).inc(v)
     pooled = ledger.pooled_walls()
+    from raft_tpu.aot import bank
+
     snap = {
         "counters": counters,
         "gauges": {},
@@ -1045,6 +1065,10 @@ def assemble(out_dir, spec=None, wall_s=None):
                            "rows", "programs_loaded", "programs_compiled",
                            "pid", "host")}
                     for wid, st in states.items()},
+        # fleet-wide device-cost ledger: every worker's per-program
+        # flops/dispatch stats merged (bench fabric block reads this)
+        "programs": bank.merge_ledgers(
+            [st.get("programs") for st in states.values()]),
     }
     manifest["metrics"] = snap
     resilience._atomic_json(resilience._manifest_path(out_dir), manifest)

@@ -37,10 +37,11 @@ from collections import deque
 import numpy as np
 
 from raft_tpu.obs import metrics
+from raft_tpu.obs.spans import span
 from raft_tpu.serve import engine
 from raft_tpu.serve.cache import ResultCache, result_cache_key
 from raft_tpu.serve.quota import ClientQuotas
-from raft_tpu.utils import config, health
+from raft_tpu.utils import config, health, structlog
 from raft_tpu.utils.structlog import log_event
 
 
@@ -77,10 +78,10 @@ class Draining(RejectError):
 
 class _Request:
     __slots__ = ("entry", "Hs", "Tp", "beta", "out_keys", "escalate_f64",
-                 "client", "future", "t_submit", "cache_key")
+                 "client", "future", "t_submit", "cache_key", "trace_ctx")
 
     def __init__(self, entry, Hs, Tp, beta, out_keys, escalate_f64, client,
-                 cache_key):
+                 cache_key, trace_ctx=None):
         self.entry = entry
         self.Hs, self.Tp, self.beta = Hs, Tp, beta
         self.out_keys = out_keys
@@ -89,6 +90,10 @@ class _Request:
         self.future = concurrent.futures.Future()
         self.t_submit = time.perf_counter()
         self.cache_key = cache_key
+        # (trace_id, span_id) of the request's serve_request span: the
+        # tick span links to it, so one trace covers client -> queue ->
+        # tick -> dispatch -> response across the thread boundary
+        self.trace_ctx = trace_ctx
 
 
 class Batcher:
@@ -128,7 +133,7 @@ class Batcher:
     # ------------------------------------------------------------ submit
 
     def submit(self, design, Hs, Tp, beta, out_keys=None, escalate_f64=False,
-               client=None):
+               client=None, trace_ctx=None):
         """Admit one evaluation request; returns a Future resolving to
         the result payload dict (``outputs``/``status``/``status_text``/
         ``cache_hit``/``escalated``).  Raises :class:`KeyError` for an
@@ -158,7 +163,7 @@ class Batcher:
             entry.fingerprint, {"Hs": Hs, "Tp": Tp, "beta": beta},
             self.out_keys, extra=engine.flags_extra())
         req = _Request(entry, Hs, Tp, beta, requested, escalate_f64, client,
-                       key)
+                       key, trace_ctx=trace_ctx)
         row = self.cache.get(key)
         if row is not None:
             # only HEALTHY rows are cached, so an opt-in escalation
@@ -212,8 +217,45 @@ class Batcher:
         groups: dict = {}
         for reqs in unique.values():
             groups.setdefault(reqs[0].entry.sig, []).append(reqs)
+        # the tick span LINKS to every coalesced request span (they live
+        # in other tasks/threads, so they cannot be its tree children):
+        # one trace then covers client -> admission queue -> tick ->
+        # bucket dispatch -> response
+        span_kw = {}
+        if structlog.enabled():
+            links = [{"trace_id": r.trace_ctx[0], "span_id": r.trace_ctx[1]}
+                     for rl in unique.values() for r in rl if r.trace_ctx]
+            if links:
+                span_kw["links"] = links
+        with span("serve_tick", rows=len(batch), unique=len(unique),
+                  **span_kw):
+            n_dispatch, deferred = self._dispatch_groups(groups)
+            # escalation re-solves run LAST (and still on this thread:
+            # _rung_flags mutates process-wide env, so a parallel
+            # escalation would leak f64 flags into a concurrent normal
+            # dispatch) — every non-escalating requester already has its
+            # result before anyone pays the solo re-solve, which on first
+            # use may trace+compile the unwarmed f64_cpu program.  The
+            # head-of-line cost that remains is the NEXT tick,
+            # documented tradeoff.
+            for rl, row in deferred:
+                self._finalize(rl, row)
+            wall = time.perf_counter() - t0
+            metrics.histogram("serve_tick_s").observe(wall)
+            log_event("serve_tick", rows=len(batch), unique=len(unique),
+                      n_groups=len(groups), dispatches=n_dispatch,
+                      wall_s=round(wall, 6))
+        with self._cond:
+            self._in_tick = False
+            self._cond.notify_all()
+        return len(batch)
+
+    def _dispatch_groups(self, groups):
+        """Dispatch every signature group of one tick; returns
+        ``(n_dispatch, deferred)`` where ``deferred`` is the
+        (reqs, row) list awaiting an f64 escalation re-solve."""
         n_dispatch = 0
-        deferred = []   # (reqs, row) needing an f64 escalation re-solve
+        deferred = []
         for sig, reqlists in groups.items():
             cap = self.sizes[-1]
             for lo in range(0, len(reqlists), cap):
@@ -243,24 +285,7 @@ class Batcher:
                         deferred.append((rl, row))
                     else:
                         self._finalize(rl, row)
-        # escalation re-solves run LAST (and still on this thread:
-        # _rung_flags mutates process-wide env, so a parallel escalation
-        # would leak f64 flags into a concurrent normal dispatch) —
-        # every non-escalating requester already has its result before
-        # anyone pays the solo re-solve, which on first use may
-        # trace+compile the unwarmed f64_cpu program.  The head-of-line
-        # cost that remains is the NEXT tick, documented tradeoff.
-        for rl, row in deferred:
-            self._finalize(rl, row)
-        wall = time.perf_counter() - t0
-        metrics.histogram("serve_tick_s").observe(wall)
-        log_event("serve_tick", rows=len(batch), unique=len(unique),
-                  n_groups=len(groups), dispatches=n_dispatch,
-                  wall_s=round(wall, 6))
-        with self._cond:
-            self._in_tick = False
-            self._cond.notify_all()
-        return len(batch)
+        return n_dispatch, deferred
 
     @staticmethod
     def _needs_escalation(reqs, row):
@@ -308,8 +333,16 @@ class Batcher:
         }
         if not req.future.set_running_or_notify_cancel():
             return  # requester went away (client timeout/cancel)
-        metrics.histogram("serve_request_s").observe(
-            time.perf_counter() - req.t_submit)
+        wall = time.perf_counter() - req.t_submit
+        metrics.histogram("serve_request_s").observe(wall)
+        # the sliding-window twin of the lifetime histogram: /healthz
+        # p50/p95-over-last-N-seconds and the SLO breach gate read this
+        metrics.window("serve_request_window_s").observe(wall)
+        slo_ms = float(config.get("SERVE_SLO_MS") or 0)
+        if slo_ms > 0 and wall * 1e3 > slo_ms:
+            metrics.counter("serve_slo_breaches").inc()
+            log_event("slo_breach", wall_s=round(wall, 6), slo_ms=slo_ms,
+                      client=str(req.client), cache_hit=bool(cache_hit))
         req.future.set_result(result)
 
     # ------------------------------------------------------- tick thread

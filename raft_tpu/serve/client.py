@@ -29,6 +29,9 @@ class ServeClient:
         self.client_id = client_id
         self.timeout = timeout
         self._conn = None
+        #: response headers of the last completed round trip (the
+        #: distributed-tracing tests read `traceparent` back from here)
+        self.last_headers = {}
 
     def _connection(self):
         if self._conn is None:
@@ -41,11 +44,11 @@ class ServeClient:
             self._conn.close()
             self._conn = None
 
-    def request(self, method, path, payload=None):
+    def request(self, method, path, payload=None, headers=None):
         """One round trip; returns ``(status_code, parsed_body)`` —
         JSON-decoded when possible, raw text otherwise (``/metrics``)."""
         body = None
-        headers = {}
+        headers = dict(headers or {})
         if payload is not None:
             body = json.dumps(payload)
             headers["Content-Type"] = "application/json"
@@ -72,6 +75,7 @@ class ServeClient:
             self.close()
             raise ResponseDropped(
                 f"connection lost awaiting {method} {path}: {e!r}") from e
+        self.last_headers = {k.lower(): v for k, v in resp.getheaders()}
         if resp.will_close:
             self.close()
         try:
@@ -80,13 +84,14 @@ class ServeClient:
             return resp.status, data.decode(errors="replace")
 
     def evaluate(self, design, Hs, Tp, beta, out_keys=None,
-                 escalate_f64=False):
+                 escalate_f64=False, traceparent=None):
         payload = {"design": design, "Hs": Hs, "Tp": Tp, "beta": beta}
         if out_keys:
             payload["out_keys"] = list(out_keys)
         if escalate_f64:
             payload["escalate_f64"] = True
-        return self.request("POST", "/evaluate", payload)
+        headers = {"traceparent": traceparent} if traceparent else None
+        return self.request("POST", "/evaluate", payload, headers=headers)
 
     def healthz(self):
         return self.request("GET", "/healthz")

@@ -43,6 +43,8 @@ import time
 import numpy as np
 
 from raft_tpu.obs import metrics
+from raft_tpu.obs.spans import (current_ids, format_traceparent,
+                                parse_traceparent, span)
 from raft_tpu.serve import batcher as batcher_mod
 from raft_tpu.utils import config
 from raft_tpu.utils.structlog import log_event
@@ -94,7 +96,26 @@ class Server:
 
     # ------------------------------------------------------------ routes
 
-    async def _evaluate(self, body, client):
+    async def _evaluate(self, body, client, traceparent=None):
+        """One /evaluate request under its ``serve_request`` span: the
+        span adopts the client's ``traceparent`` (when sent) so the
+        request joins the caller's trace, and its ids ride into the
+        batcher so the tick span can link back — one trace from HTTP
+        accept through coalescing to the banked-program dispatch.
+        Returns ``(status, payload, extra_headers)``."""
+        req_span = span("serve_request", endpoint="/evaluate",
+                        remote=parse_traceparent(traceparent),
+                        client=str(client))
+        with req_span:
+            status, payload = await self._evaluate_inner(body, client)
+        hdrs = {}
+        tp = format_traceparent(req_span.trace_id, req_span.span_id) \
+            if req_span.span_id else None
+        if tp:
+            hdrs["traceparent"] = tp
+        return status, payload, hdrs
+
+    async def _evaluate_inner(self, body, client):
         try:
             payload = json.loads(body or b"{}")
         except (ValueError, UnicodeDecodeError) as e:
@@ -144,7 +165,7 @@ class Server:
                 entry, case["Hs"], case["Tp"], case["beta"],
                 out_keys=tuple(out_keys) if out_keys else None,
                 escalate_f64=bool(payload.get("escalate_f64")),
-                client=client)
+                client=client, trace_ctx=current_ids())
         except batcher_mod.QuotaExceeded as e:
             return 429, {"ok": False, "error": "client quota exceeded",
                          "retry_after_s": round(e.retry_after_s, 3)}
@@ -165,6 +186,7 @@ class Server:
 
     def _healthz(self):
         from raft_tpu.analysis.recompile import PROCESS_LOG
+        from raft_tpu.aot import bank
 
         snap = {c: metrics.counter(c).value for c in
                 ("aot_programs_loaded", "aot_programs_compiled",
@@ -174,6 +196,9 @@ class Server:
                  "serve_errors", "serve_escalations")}
         occ = metrics.histogram("serve_batch_occupancy").snapshot()
         lat = metrics.histogram("serve_request_s").snapshot()
+        window_s = float(config.get("SERVE_WINDOW_S"))
+        win = metrics.window("serve_request_window_s").snapshot(window_s)
+        slo_ms = float(config.get("SERVE_SLO_MS") or 0)
         return 200, {
             "ok": True,
             "draining": self.batcher.draining,
@@ -182,18 +207,29 @@ class Server:
             "xla_real_compiles": PROCESS_LOG.real_count,
             "batch_occupancy": occ,
             "request_latency_s": lat,
+            # the sliding view an operator actually pages on: p50/p95
+            # over the last RAFT_TPU_SERVE_WINDOW_S seconds + SLO state
+            "window": win,
+            "slo": {"slo_ms": slo_ms or None,
+                    "breaches": metrics.counter("serve_slo_breaches").value},
+            # device-cost ledger: per-program flops / dispatches /
+            # achieved GFLOP/s (populated when the AOT bank is armed)
+            "cost_ledger": bank.ledger_summary(),
             **self.batcher.stats(),
             **snap,
         }
 
-    async def _route(self, method, path, body, client):
+    async def _route(self, method, path, body, client, headers):
+        """Returns ``(status, payload)`` or ``(status, payload,
+        extra_response_headers)``."""
         if path == "/evaluate":
             if method != "POST":
                 return 405, {"ok": False, "error": "POST required"}
             if self.batcher.draining:
                 return 503, {"ok": False, "error": "service is draining",
                              "reason": "draining"}
-            return await self._evaluate(body, client)
+            return await self._evaluate(body, client,
+                                        traceparent=headers.get("traceparent"))
         if method != "GET":
             return 405, {"ok": False, "error": "GET required"}
         if path == "/healthz":
@@ -230,7 +266,7 @@ class Server:
         return method, path, headers, body
 
     @staticmethod
-    def _response_bytes(status, payload, keep_alive):
+    def _response_bytes(status, payload, keep_alive, extra_headers=None):
         if isinstance(payload, (dict, list)):
             data = json.dumps(payload).encode()
             ctype = "application/json"
@@ -241,6 +277,8 @@ class Server:
                 f"Content-Type: {ctype}",
                 f"Content-Length: {len(data)}",
                 f"Connection: {'keep-alive' if keep_alive else 'close'}"]
+        for name, value in (extra_headers or {}).items():
+            head.append(f"{name}: {value}")
         if status == 429 and isinstance(payload, dict):
             head.append(
                 f"Retry-After: {max(1, int(payload.get('retry_after_s') or 0) + 1)}")
@@ -265,15 +303,19 @@ class Server:
                 method, path, headers, body = req
                 client = headers.get("x-client") or peer_host
                 t0 = time.perf_counter()
+                extra = None
                 try:
-                    status, payload = await self._route(method, path, body,
-                                                        client)
+                    routed = await self._route(method, path, body,
+                                               client, headers)
+                    status, payload = routed[0], routed[1]
+                    extra = routed[2] if len(routed) > 2 else None
                 except Exception as e:  # noqa: BLE001 — keep serving
                     status, payload = 500, {"ok": False,
                                             "error": repr(e)[:300]}
                 keep = (headers.get("connection", "keep-alive").lower()
                         != "close") and not self.batcher.draining
-                writer.write(self._response_bytes(status, payload, keep))
+                writer.write(self._response_bytes(status, payload, keep,
+                                                  extra))
                 await writer.drain()
                 log_event("serve_request", endpoint=path, method=method,
                           code=status, client=str(client),

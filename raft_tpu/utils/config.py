@@ -229,12 +229,24 @@ _register(
          help="jax platform pin for `python -m raft_tpu` (cpu also "
               "enables x64 for the parity path)"),
     Flag("LOG", "raw", "",
-         help="structured-log sink: '-' for stderr, else a JSONL path"),
+         help="structured-log sink: '-' for stderr, a JSONL path, or a "
+              "DIRECTORY (existing, or written with a trailing slash) — "
+              "each process then appends to its own "
+              "<dir>/trace-<pid>.jsonl shard, merged offline by "
+              "`python -m raft_tpu.obs trace --merge <dir>`"),
     # -- telemetry (see raft_tpu.obs and README "Observability")
     Flag("RUN_ID", "raw", "",
          help="telemetry run id stamped on every structured-log record "
               "(default: a fresh uuid per process; pin it so a resumed "
-              "sweep's events stay linkable to the original run)"),
+              "sweep's events stay linkable to the original run; the "
+              "fabric coordinator pins it into worker env automatically)"),
+    Flag("TRACEPARENT", "raw", "",
+         help="W3C traceparent (00-<trace>-<span>-01) inherited from a "
+              "parent process: the first root span of this process "
+              "joins that trace instead of minting a fresh trace_id "
+              "(set by the fabric coordinator for spawned workers; "
+              "accepted/emitted as the `traceparent` HTTP header by "
+              "the evaluation service)"),
     Flag("HEARTBEAT_S", "float", 0.0,
          help="device-heartbeat sampling period in seconds (0 disables): "
               "a daemon thread emits per-device memory_stats, live-buffer "
@@ -303,6 +315,16 @@ _register(
     Flag("SERVE_DRAIN_S", "float", 120.0,
          help="graceful-shutdown budget: SIGTERM finishes in-flight "
               "ticks and open responses within this window"),
+    Flag("SERVE_SLO_MS", "float", 0.0,
+         help="per-request latency SLO in milliseconds (0 disables): a "
+              "request resolving slower than this increments the "
+              "serve_slo_breaches counter and emits an slo_breach "
+              "event; /healthz reports breaches next to the sliding-"
+              "window p50/p95"),
+    Flag("SERVE_WINDOW_S", "float", 60.0,
+         help="sliding-window length (seconds) of the serve latency "
+              "time-series: /healthz p50/p95/rate are computed over "
+              "the last this-many seconds, not process lifetime"),
     # -- multi-host distributed runtime (dryrun-tested on CPU; wired
     #    into resilience.resolve_mesh for real pods)
     Flag("DIST", "bool", False,

@@ -74,6 +74,19 @@ def run_id():
     return _RUN_ID
 
 
+def _sink_path(dest):
+    """The actual file path for a non-stderr dest: a directory (an
+    existing one, or any path spelled with a trailing separator) holds
+    one ``trace-<pid>.jsonl`` shard PER PROCESS — the cross-process
+    capture layout `python -m raft_tpu.obs trace --merge` assembles
+    (fabric coordinator + workers + server each own their shard, no
+    cross-process write interleaving)."""
+    if dest.endswith(os.sep) or dest.endswith("/") or os.path.isdir(dest):
+        os.makedirs(dest, exist_ok=True)
+        return os.path.join(dest, f"trace-{os.getpid()}.jsonl")
+    return dest
+
+
 def _sink():
     """Resolve the sink from RAFT_TPU_LOG, re-reading the env var on
     every call so setting/changing/unsetting it mid-process takes
@@ -92,7 +105,7 @@ def _sink():
                 if dest == "-":
                     _SINK = sys.stderr
                 elif dest:
-                    _SINK = open(dest, "a")
+                    _SINK = open(_sink_path(dest), "a")
                     atexit.register(_SINK.close)
                 else:
                     _SINK = None
@@ -102,6 +115,28 @@ def _sink():
 
 def enabled():
     return _sink() is not None
+
+
+#: dests this process has written its clock anchor to (the merge
+#: tooling needs one ``proc_start`` per (process, sink) to map the
+#: monotonic ``t`` column onto a shared wall clock)
+_ANCHORED: set = set()
+
+
+def _anchor_record():
+    """The clock-anchor record: ``unix_t`` is the wall-clock time at
+    which this record's monotonic ``t`` was read, so a merge can
+    normalize every process's ``t`` onto one timeline
+    (``wall = unix_t + (t - t_anchor)``)."""
+    now = time.perf_counter() - _T0
+    rec = {"t": round(now, 6), "event": "proc_start",
+           "pid": os.getpid(), "run_id": run_id(),
+           "unix_t": round(time.time(), 6),
+           "argv0": os.path.basename(sys.argv[0] or "python")}
+    wid = config.raw("WORKER_ID")
+    if wid:
+        rec["worker"] = wid
+    return rec
 
 
 def log_event(event, **payload):
@@ -139,6 +174,12 @@ def log_event(event, **payload):
         s = _sink()
         if s is None:
             return
+        if _DEST not in _ANCHORED:
+            # first record to this sink: lead with the clock anchor so
+            # the merge tooling can place this process on a shared
+            # wall-clock timeline
+            _ANCHORED.add(_DEST)
+            s.write(json.dumps(_anchor_record(), default=str) + "\n")
         s.write(line)
         s.flush()
 

@@ -99,6 +99,22 @@ def test_roundtrip_bit_identical_vs_fresh_trace(bank_dir, monkeypatch):
     for k in out_fresh:
         np.testing.assert_array_equal(out_fresh[k], out_loaded[k])
 
+    # device-cost ledger: the sidecar carries a non-empty cost_analysis
+    # block (acceptance: every banked program's sidecar does) and both
+    # the export and the load registered the program in the ledger with
+    # its dispatch stats
+    with open(bank_files(bank_dir)[0]) as f:
+        meta = json.load(f)
+    cost = meta["cost_analysis"]
+    assert cost and cost["flops"] > 0 and cost["arg_bytes"] > 0
+    rows = {r["key"]: r for r in bank.ledger_summary()}
+    assert meta["key"] in rows
+    row = rows[meta["key"]]
+    assert row["flops"] == cost["flops"]
+    assert row["dispatches"] >= 2 and row["wall_s"] > 0
+    assert row["gflops_s_mean"] > 0
+    assert metrics.counter("program_dispatches").value >= 2
+
 
 def test_flag_flip_is_a_miss(bank_dir, monkeypatch):
     """A trace-time flag flip changes the key: require mode fails

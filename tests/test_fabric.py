@@ -270,10 +270,31 @@ def test_two_worker_sweep_bit_identical_to_serial(tmp_path, log_path,
         <= {"w0", "w1"}
     assert man["metrics"]["counters"].get("shards_done") == 6
     # per-worker table renders from the shared capture
-    from raft_tpu.obs.report import render_report
+    from raft_tpu.obs.report import collect_spans, render_report
 
     txt = render_report(_events(log_path))
     assert "fabric workers" in txt and "w0" in txt and "w1" in txt
+
+    # --- telemetry linkage (the 5-unlinked-timelines bug): the
+    # coordinator pins its run id into worker env, so EVERY record —
+    # coordinator, w0, w1 — shares one run_id instead of 3 uuids
+    evs = _events(log_path)
+    assert len({e["run_id"] for e in evs}) == 1
+    # ...and the workers' shard spans join the coordinator's trace:
+    # remote-parented onto the sweep span via RAFT_TPU_TRACEPARENT
+    spans_, _ = collect_spans(evs)
+    sweep = [s for s in spans_ if s["name"] == "sweep"][-1]
+    shard_spans = [s for s in spans_ if s["name"] == "shard"
+                   and s["pid"] != os.getpid()]
+    assert shard_spans, "worker shard spans missing from the capture"
+    assert {s["trace_id"] for s in shard_spans} == {sweep["trace_id"]}
+    assert {s["parent_id"] for s in shard_spans} == {sweep["span_id"]}
+    # lease bookkeeping carries the trace context too: done records
+    # written by workers stamp (trace_id, parent_span_id)
+    ledger = fabric.Ledger(out_dir, 6)
+    recs = [ledger.read_done(s) for s in range(6)]
+    assert all(r.get("trace_id") == sweep["trace_id"] for r in recs)
+    assert all(r.get("parent_span_id") == sweep["span_id"] for r in recs)
 
 
 def test_kill_a_worker_completes_bit_identical(tmp_path, log_path,
@@ -306,6 +327,10 @@ def test_kill_a_worker_completes_bit_identical(tmp_path, log_path,
     # 2s test TTL)
     assert steals and steals[0]["from_worker"] == "w0" \
         and steals[0]["reason"] in ("expired", "straggler", "holder_stale")
+    # the whole drill — SIGKILL, steal, re-execution — happened under
+    # ONE run_id: the killed worker, the stealer and the coordinator
+    # all carry the pinned id, so the recovery story reads as one run
+    assert len({e["run_id"] for e in _events(log_path)}) == 1
     exits = {e["worker"]: e["returncode"]
              for e in _events(log_path, "fabric_worker_exit")}
     assert exits["w0"] != 0 and exits["w1"] == 0    # SIGKILL really hit

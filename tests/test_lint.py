@@ -66,6 +66,26 @@ def test_bad_event_fixture():
     assert any("shard_don" in f.message for f in found)
 
 
+def test_bad_span_fixture():
+    found = run_fixture("bad_span.py")
+    assert {f.rule for f in found} == {"span-name"}
+    # registered name (13) and the dynamic name (15) are clean
+    assert {f.line for f in found} == {9, 11}
+    assert any("shrad" in f.message for f in found)
+    assert any("sweep_dispach" in f.message for f in found)
+
+
+def test_span_registry_covers_runtime_emitters():
+    """Every literal span name in the scanned tree is registered in the
+    SPANS table, and the table describes each name."""
+    from raft_tpu.obs import events
+
+    findings = [f for f in lint.lint_paths() if f.rule == "span-name"]
+    assert findings == [], "\n".join(f.format() for f in findings)
+    for name, help_ in events.describe_spans():
+        assert help_, name
+
+
 def test_event_registry_covers_runtime_emitters():
     """Every literal log_event name in the scanned tree is registered
     (the CI-gate property the rule exists for), and the registry itself

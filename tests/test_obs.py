@@ -119,6 +119,10 @@ def test_span_failure_records_error_and_reraises(log_path):
 def test_zero_overhead_fast_path_when_log_unset(monkeypatch):
     monkeypatch.delenv("RAFT_TPU_LOG", raising=False)
     monkeypatch.delenv("RAFT_TPU_PROFILE", raising=False)
+    # the propagation path must ride the same fast path: an inherited
+    # traceparent is only parsed/adopted when the sink is live
+    monkeypatch.setenv("RAFT_TPU_TRACEPARENT",
+                       "00-" + "a" * 32 + "-" + "b" * 16 + "-01")
     with span("quiet", x=1) as s:
         # no ids generated, no contextvar touched, nothing emitted
         assert s.span_id is None and current_ids() is None
@@ -126,6 +130,158 @@ def test_zero_overhead_fast_path_when_log_unset(monkeypatch):
     # the wall-time histogram still feeds (metrics are independent of
     # the event stream) — but no event was produced anywhere
     assert metrics.histogram("span_quiet_s").count == 1
+
+
+# ----------------------------------------------- cross-process propagation
+
+
+def test_traceparent_parse_format_roundtrip():
+    from raft_tpu.obs import spans
+
+    tp = spans.format_traceparent("a" * 16, "b" * 16)
+    assert tp == "00-" + "0" * 16 + "a" * 16 + "-" + "b" * 16 + "-01"
+    assert spans.parse_traceparent(tp) == ("a" * 16, "b" * 16)
+    # foreign 32-hex trace ids keep their full width
+    full = "1234567890abcdef" * 2
+    assert spans.parse_traceparent(f"00-{full}-{'c' * 16}-01") == \
+        (full, "c" * 16)
+    # garbage / all-zero ids are "no context", never an exception
+    assert spans.parse_traceparent(None) is None
+    assert spans.parse_traceparent("nonsense") is None
+    assert spans.parse_traceparent(
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01") is None
+    assert spans.format_traceparent() is None  # no active span
+
+
+def test_root_span_adopts_env_traceparent(log_path, monkeypatch):
+    from raft_tpu.obs import spans
+
+    monkeypatch.setenv("RAFT_TPU_TRACEPARENT",
+                       spans.format_traceparent("feed" * 4, "beef" * 4))
+    with span("sweep") as outer:
+        assert outer.trace_id == "feed" * 4
+        with span("shard") as inner:
+            pass
+    begins = {e["name"]: e for e in _events(log_path, "span_begin")}
+    # the root joined the inherited trace with the remote span as parent
+    assert begins["sweep"]["parent_id"] == "beef" * 4
+    assert begins["sweep"]["remote_parent"] is True
+    # nesting below the root is untouched
+    assert begins["shard"]["parent_id"] == outer.span_id
+    assert begins["shard"]["trace_id"] == "feed" * 4
+    assert "remote_parent" not in begins["shard"]
+
+
+def test_propagation_env_and_ambient_ids(log_path, monkeypatch):
+    from raft_tpu.obs import spans
+
+    monkeypatch.delenv("RAFT_TPU_TRACEPARENT", raising=False)
+    monkeypatch.setenv("RAFT_TPU_RUN_ID", "prop01")
+    assert spans.ambient_ids() is None
+    with span("sweep") as s:
+        env = spans.propagation_env()
+        assert env["RAFT_TPU_RUN_ID"] == "prop01"
+        assert spans.parse_traceparent(env["RAFT_TPU_TRACEPARENT"]) == \
+            (s.trace_id, s.span_id)
+        assert spans.ambient_ids() == (s.trace_id, s.span_id)
+    # outside a span: run id still pinned, inherited context chains
+    monkeypatch.setenv("RAFT_TPU_TRACEPARENT",
+                       spans.format_traceparent("c" * 16, "d" * 16))
+    env = spans.propagation_env()
+    assert spans.parse_traceparent(env["RAFT_TPU_TRACEPARENT"]) == \
+        ("c" * 16, "d" * 16)
+    assert spans.ambient_ids() == ("c" * 16, "d" * 16)
+
+
+def test_log_directory_shards_per_process(tmp_path, monkeypatch):
+    d = tmp_path / "capture"
+    monkeypatch.setenv("RAFT_TPU_LOG", str(d) + os.sep)
+    structlog.log_event("shard_start", shard=0, rows=4)
+    shard_file = d / f"trace-{os.getpid()}.jsonl"
+    assert shard_file.exists()
+    evs = _events(str(shard_file))
+    # the shard opens with the proc_start clock anchor
+    assert evs[0]["event"] == "proc_start"
+    assert evs[0]["unix_t"] > 1e9 and "argv0" in evs[0]
+    assert evs[1]["event"] == "shard_start"
+
+
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "obs")
+
+
+def test_merge_captures_normalizes_clocks():
+    evs, bad, info = obs_report.merge_captures([FIXTURE_DIR])
+    assert bad == 0 and info["files"] == 2 and not info["unanchored_files"]
+    # worker events land ~1.2s after the coordinator on the SHARED
+    # clock (unix anchors 1700000000.0 vs 1700000001.2)
+    by = {(e["pid"], e["event"]): e["t"] for e in evs}
+    assert abs(by[(202, "proc_start")] - 1.2) < 1e-6
+    assert by[(101, "span_begin")] < by[(202, "fabric_worker_start")]
+    # t is sorted and zero-based
+    assert evs[0]["t"] == 0.0
+    assert all(a["t"] <= b["t"] for a, b in zip(evs, evs[1:]))
+    trace = obs_report.chrome_trace(evs, merged=True)
+    meta = trace["otherData"]
+    assert meta["spans_matched"] == 4 and meta["spans_unmatched"] == 0
+    # the acceptance property: every worker span resolves to its
+    # coordinator parent after the merge — no orphans, ONE trace
+    assert meta["spans_orphaned"] == 0 and meta["traces"] == 1
+    assert meta["pids"] == 2 and meta["run_ids"] == ["fixture01"]
+
+
+def test_externally_traced_request_is_not_an_orphan():
+    """A serve request adopting an HTTP client's traceparent has a
+    parent span living in the CLIENT's tracer — --check must not flag
+    it.  But the same shape across two captured processes (a worker
+    whose coordinator parent SHOULD be in the capture) stays an
+    orphan."""
+    def span_pair(pid, trace, sid, parent, remote):
+        b = {"t": 0.1, "event": "span_begin", "pid": pid, "run_id": "r",
+             "trace_id": trace, "span_id": sid, "name": "shard",
+             "parent_id": parent}
+        if remote:
+            b["remote_parent"] = True
+        e = {"t": 0.2, "event": "span_end", "pid": pid, "run_id": "r",
+             "trace_id": trace, "span_id": sid, "name": "shard",
+             "wall_s": 0.1, "ok": True}
+        return [b, e]
+
+    # single process, remote parent outside the capture: clean
+    evs = span_pair(1, "t1", "s1", "client-span", remote=True)
+    assert obs_report.chrome_trace(evs)["otherData"]["spans_orphaned"] == 0
+    # two processes share the trace but the parent is missing: orphan
+    evs = (span_pair(1, "t1", "s1", "lost-parent", remote=True)
+           + span_pair(2, "t1", "s2", "s1", remote=False))
+    assert obs_report.chrome_trace(evs)["otherData"]["spans_orphaned"] == 1
+    # non-remote dangling parent is always an orphan
+    evs = span_pair(1, "t1", "s1", "gone", remote=False)
+    assert obs_report.chrome_trace(evs)["otherData"]["spans_orphaned"] == 1
+
+
+def test_merge_cli_check_gates_orphans(tmp_path):
+    out = str(tmp_path / "t.json")
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs", "trace", "--merge",
+         FIXTURE_DIR, "-o", out, "--check"],
+        capture_output=True, text=True, cwd=REPO)
+    assert p.returncode == 0, p.stdout + p.stderr
+    # a capture whose span parent resolves nowhere must FAIL the check
+    broken = tmp_path / "trace-9.jsonl"
+    broken.write_text(
+        '{"t": 0.0, "event": "proc_start", "pid": 9, "run_id": "r",'
+        ' "unix_t": 1700000000.0}\n'
+        '{"t": 0.1, "event": "span_begin", "pid": 9, "run_id": "r",'
+        ' "trace_id": "t9", "span_id": "s9", "name": "shard",'
+        ' "parent_id": "gone"}\n'
+        '{"t": 0.2, "event": "span_end", "pid": 9, "run_id": "r",'
+        ' "trace_id": "t9", "span_id": "s9", "name": "shard",'
+        ' "wall_s": 0.1, "ok": true}\n')
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs", "trace", "--merge",
+         str(broken), "-o", out, "--check"],
+        capture_output=True, text=True, cwd=REPO)
+    assert p.returncode == 1
+    assert "orphan" in p.stderr
 
 
 def test_sweep_spans_and_run_id_survive_resume(tmp_path, monkeypatch):
@@ -198,6 +354,74 @@ def test_kind_collision_is_loud():
     metrics.counter("t_kind")
     with pytest.raises(TypeError, match="already registered"):
         metrics.gauge("t_kind")
+
+
+def test_window_percentiles_and_expiry():
+    w = metrics.window("t_win")
+    # empty window: None percentiles, zero-count snapshot
+    assert w.percentile(0.5) is None
+    assert w.snapshot()["count"] == 0
+    now = time.perf_counter()
+    w.observe(99.0, t=now - 120.0)       # outside the 60s window
+    for v in (1.0, 2.0, 3.0, 4.0):
+        w.observe(v, t=now - 1.0)
+    snap = w.snapshot(now=now)
+    assert snap["count"] == 4 and snap["total"] == 5
+    assert snap["p50"] in (2.0, 3.0) and snap["p95"] == 4.0
+    assert snap["max"] == 4.0            # the expired 99.0 is gone
+    # a custom window length re-admits the old sample
+    assert w.percentile(1.0, window_s=300.0, now=now) == 99.0
+    # everything aged out -> empty again (no stale percentiles)
+    assert w.percentile(0.5, window_s=0.5, now=now) is None
+
+
+def test_window_ring_wraparound():
+    w = metrics.Window("t_ring", maxlen=8)
+    now = time.perf_counter()
+    for i in range(100):
+        w.observe(float(i), t=now)
+    snap = w.snapshot(now=now)
+    # the ring keeps only the newest maxlen samples
+    assert snap["count"] == 8 and snap["total"] == 100
+    assert w.values(now=now) == [float(i) for i in range(92, 100)]
+
+
+def test_window_in_snapshot_and_prometheus():
+    metrics.window("t_win_prom").observe(0.25)
+    snap = metrics.snapshot()
+    assert snap["windows"]["t_win_prom"]["count"] == 1
+    text = metrics.to_prometheus()
+    assert "raft_tpu_t_win_prom_p95 0.25" in text
+    assert "raft_tpu_t_win_prom_count 1" in text
+    # non-serving processes keep the old snapshot schema
+    metrics.reset()
+    metrics.counter("t_plain").inc()
+    assert "windows" not in metrics.snapshot()
+
+
+def test_merge_states_edge_cases():
+    h = metrics.Histogram("a")
+    for v in (0.1, 0.2, 0.4, 3.0):
+        h.observe(v)
+    st = h.state()
+    # empty / garbled states are ignored, not poison
+    pooled = metrics.merge_states([None, {}, {"count": 0}, "garbage", st])
+    assert pooled.count == 4
+    assert pooled.min == 0.1 and pooled.max == 3.0
+    # merge-with-self: counts add exactly, extrema/percentile stable
+    twice = metrics.merge_states([st, st])
+    assert twice.count == 8 and twice.sum == pytest.approx(2 * h.sum)
+    assert twice.min == h.min and twice.max == h.max
+    assert twice.percentile(0.5) == h.percentile(0.5)
+    # disjoint bucket layouts: both contributions survive the pool
+    lo = metrics.Histogram("lo")
+    hi = metrics.Histogram("hi")
+    lo.observe(1e-5)
+    hi.observe(1e4)
+    pooled = metrics.merge_states([lo.state(), hi.state()])
+    assert pooled.count == 2
+    assert pooled.min == 1e-5 and pooled.max == 1e4
+    assert pooled.percentile(0.99) == pytest.approx(1e4)
 
 
 def test_prometheus_export(tmp_path):
@@ -341,7 +565,100 @@ def test_events_cli_lists_registry():
     assert "span_begin" in p.stdout and "heartbeat" in p.stdout
 
 
+@pytest.mark.slow
+def test_fleet_trace_merge_e2e(tmp_path, monkeypatch):
+    """The acceptance drill: a 2-worker fabric sweep plus one served
+    request, captured as per-process shards, merge into a SINGLE
+    Perfetto timeline — the serve dispatch span resolves to its tick,
+    both workers' shard spans resolve to the coordinator's sweep span,
+    no orphan spans, one run_id."""
+    capture = str(tmp_path / "capture") + os.sep
+    monkeypatch.setenv("RAFT_TPU_LOG", capture)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_TTL_S", "2.0")
+    monkeypatch.setenv("RAFT_TPU_FABRIC_POLL_S", "0.1")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import _fabric_entry
+    from raft_tpu.parallel import fabric
+
+    entry_file = os.path.abspath(_fabric_entry.__file__)
+    cases = _cases(16, seed=9)
+    fabric.run_fabric(
+        str(tmp_path / "fab"), workers=2,
+        entry=f"{entry_file}:slow_toy_entry",
+        entry_kwargs={"delay_s": 0.2}, cases=cases,
+        out_keys=("PSD", "X0"), shard_size=4)
+
+    # one served request through the batcher, client-traced: the
+    # request span is open at submit (as the HTTP layer does), the tick
+    # runs afterwards on its own root (as the dispatcher thread does)
+    from raft_tpu.serve.batcher import Batcher
+    from raft_tpu.serve.engine import Registry
+
+    registry = Registry()
+    registry.register("spar", os.path.join(
+        REPO, "raft_tpu", "designs", "spar_demo.yaml"))
+    from raft_tpu.parallel.sweep import make_mesh
+
+    batcher = Batcher(registry, mesh=make_mesh(1), tick_ms=5, max_batch=2)
+    with span("serve_request", endpoint="/evaluate") as req:
+        fut = batcher.submit("spar", 6.0, 11.0, 0.125,
+                             trace_ctx=current_ids())
+    batcher.run_tick()
+    fut.result(timeout=120)
+
+    events, bad, info = obs_report.merge_captures([capture])
+    assert bad == 0
+    assert info["files"] == 3          # pytest process + 2 workers
+    assert not info["unanchored_files"]
+    assert len({e["run_id"] for e in events}) == 1
+    trace = obs_report.chrome_trace(events, merged=True)
+    meta = trace["otherData"]
+    assert meta["pids"] == 3
+    assert meta["spans_unmatched"] == 0
+    assert meta["spans_orphaned"] == 0     # every parent id resolves
+    spans, _ = obs_report.collect_spans(events)
+    by_id = {s["span_id"]: s for s in spans}
+    sweep = [s for s in spans if s["name"] == "sweep"][-1]
+    shards = [s for s in spans if s["name"] == "shard"]
+    assert {s["pid"] for s in shards} and all(
+        s["pid"] != os.getpid() for s in shards)
+    assert len({s["pid"] for s in shards}) == 2    # both workers spoke
+    for s in shards:
+        assert s["trace_id"] == sweep["trace_id"]
+        assert by_id[s["parent_id"]] is sweep
+    # the serve side: dispatch -> tick (tree), tick -> request (link)
+    tick = [s for s in spans if s["name"] == "serve_tick"][-1]
+    dispatch = [s for s in spans if s["name"] == "sweep_dispatch"
+                and s["trace_id"] == tick["trace_id"]][-1]
+    assert by_id[dispatch["parent_id"]] is tick
+    assert {(l["trace_id"], l["span_id"])
+            for l in tick["attrs"]["links"]} == \
+        {(req.trace_id, req.span_id)}
+
+
 # -------------------------------------------------------------- heartbeat
+
+
+def test_report_renders_program_cost_table():
+    evs = [
+        {"t": 0.0, "event": "program_cost", "pid": 1, "run_id": "r",
+         "kind": "cases", "key": "k1", "source": "load",
+         "flops": 2.0e9, "arg_bytes": 4096},
+        {"t": 0.1, "event": "program_dispatch", "pid": 1, "run_id": "r",
+         "key": "k1", "kind": "cases", "wall_s": 0.5, "gflops_s": 4.0},
+        {"t": 0.2, "event": "program_dispatch", "pid": 1, "run_id": "r",
+         "key": "k1", "kind": "cases", "wall_s": 0.5, "gflops_s": 4.0},
+        {"t": 0.3, "event": "bucket_sweep", "pid": 1, "run_id": "r",
+         "rows": 8, "n_buckets": 1, "n_designs": 2,
+         "padding_waste_frac": 0.25},
+    ]
+    txt = obs_report.render_report(evs)
+    assert "program cost ledger" in txt
+    # 2 dispatches of a 2-GFLOP program over 1.0s total -> 4 GFLOP/s,
+    # padding-adjusted by the 0.75 occupancy
+    assert "k1" in txt and "4.00" in txt and "3.00" in txt
+    assert "occupancy 0.750" in txt
 
 
 def test_heartbeat_samples_devices_and_progress(log_path):
@@ -368,6 +685,15 @@ def test_heartbeat_thread_lifecycle(log_path, monkeypatch):
     assert not hb.is_alive()
     # sampled while running, plus the final beat on stop
     assert len(_events(log_path, "heartbeat")) >= 2
+
+
+def test_heartbeat_carries_window_snapshots(log_path):
+    metrics.window("t_hb_win").observe(0.125)
+    hb = Heartbeat(0.02)
+    hb.beat()
+    (ev,) = _events(log_path, "heartbeat")
+    assert ev["windows"]["t_hb_win"]["count"] == 1
+    assert ev["windows"]["t_hb_win"]["p95"] == 0.125
 
 
 def test_heartbeat_disabled_by_default(monkeypatch):

@@ -304,22 +304,28 @@ def test_structlog_events(tmp_path, monkeypatch):
         pass
     sl.log_event("custom", resid=1.5e-3, converged=True)
     lines = [json.loads(x) for x in dest.read_text().splitlines()]
-    assert lines[0]["event"] == "unit_stage"
-    assert lines[0]["ok"] is True and lines[0]["case"] == 3
-    assert lines[0]["wall_s"] >= 0
+    # every sink opens with the proc_start clock anchor (PR 10: the
+    # `obs trace --merge` cross-process timeline needs unix_t <-> t)
+    assert lines[0]["event"] == "proc_start" and lines[0]["unix_t"] > 1e9
+    assert lines[1]["event"] == "unit_stage"
+    assert lines[1]["ok"] is True and lines[1]["case"] == 3
+    assert lines[1]["wall_s"] >= 0
     # every record carries the pid/run_id telemetry stamps (PR 5)
     import os as _os
 
-    assert lines[1] == {"t": lines[1]["t"], "event": "custom",
-                        "pid": _os.getpid(), "run_id": lines[1]["run_id"],
+    assert lines[2] == {"t": lines[2]["t"], "event": "custom",
+                        "pid": _os.getpid(), "run_id": lines[2]["run_id"],
                         "resid": 1.5e-3, "converged": True}
-    assert lines[0]["run_id"] == lines[1]["run_id"]
+    assert lines[1]["run_id"] == lines[2]["run_id"]
 
     # retargeting mid-process takes effect without a module reload
+    # (the fresh sink gets its own anchor)
     dest2 = tmp_path / "log2.jsonl"
     monkeypatch.setenv("RAFT_TPU_LOG", str(dest2))
     sl.log_event("retargeted")
-    assert json.loads(dest2.read_text())["event"] == "retargeted"
+    anchor, ev = [json.loads(x) for x in dest2.read_text().splitlines()]
+    assert anchor["event"] == "proc_start"
+    assert ev["event"] == "retargeted"
 
     monkeypatch.delenv("RAFT_TPU_LOG")
     assert not sl.enabled()

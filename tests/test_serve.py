@@ -143,6 +143,67 @@ def test_duplicate_inflight_requests_share_one_row(serve_stack):
         assert np.array_equal(np.asarray(rows[0]), np.asarray(r))
 
 
+def test_tick_span_links_request_spans(serve_stack, tmp_path, monkeypatch):
+    """One trace across the submit/tick thread boundary: the tick span
+    records span-links to every coalesced request span, and the
+    bucket dispatch span nests under the tick."""
+    from raft_tpu.obs.report import collect_spans, read_events
+
+    _, batcher = serve_stack
+    log = str(tmp_path / "serve_events.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", log)
+    ctx_a = ("feed" * 4, "aaaa" * 4)
+    ctx_b = ("feed" * 4, "bbbb" * 4)
+    futs = [batcher.submit("spar", 5.0, 10.0, 0.0, trace_ctx=ctx_a),
+            batcher.submit("spar", 5.0, 10.0, 0.0, trace_ctx=ctx_b),
+            batcher.submit("spar", 5.5, 10.5, 0.1)]  # no client trace
+    batcher.run_tick()
+    for f in futs:
+        f.result(timeout=30)
+    evs, bad = read_events(log)
+    assert bad == 0
+    spans_, _ = collect_spans(evs)
+    by_name = {s["name"]: s for s in spans_}
+    tick = by_name["serve_tick"]
+    # the 2 deduplicated traced requests are linked (3 submits, 2
+    # unique rows, of which 2 carried a trace context)
+    links = tick["attrs"]["links"]
+    assert {(l["trace_id"], l["span_id"]) for l in links} == \
+        {ctx_a, ctx_b}
+    # the dispatch span is a tree CHILD of the tick span
+    dispatch = by_name["sweep_dispatch"]
+    assert dispatch["parent_id"] == tick["span_id"]
+    assert dispatch["trace_id"] == tick["trace_id"]
+
+
+def test_slo_breach_window_and_healthz(serve_stack, monkeypatch):
+    from raft_tpu.obs import metrics
+    from raft_tpu.serve.http import Server
+
+    _, batcher = serve_stack
+    # an absurdly tight SLO: every real dispatch breaches it
+    monkeypatch.setenv("RAFT_TPU_SERVE_SLO_MS", "0.0001")
+    b0 = metrics.counter("serve_slo_breaches").value
+    w0 = metrics.window("serve_request_window_s").total
+    fut = batcher.submit("spar", 7.0, 12.0, 0.125)
+    batcher.run_tick()
+    fut.result(timeout=30)
+    assert metrics.counter("serve_slo_breaches").value > b0
+    assert metrics.window("serve_request_window_s").total > w0
+    code, body = Server(batcher)._healthz()
+    assert code == 200
+    # the sliding-window latency view + SLO accounting + cost ledger
+    assert body["window"]["count"] >= 1 and body["window"]["p95"] > 0
+    assert body["slo"]["slo_ms"] == 0.0001
+    assert body["slo"]["breaches"] >= 1
+    assert isinstance(body["cost_ledger"], list)
+    # under RAFT_TPU_AOT=off there is nothing ledgered — but the key
+    # exists so dashboards need no schema branch
+    monkeypatch.setenv("RAFT_TPU_SERVE_SLO_MS", "0")
+    code, body = Server(batcher)._healthz()
+    assert body["slo"]["slo_ms"] is None
+
+
 @pytest.mark.slow
 def test_bucket_group_routing_parity_vs_solo(serve_stack):
     """Mixed spar+semi tick: one dispatch per bucket signature, every
@@ -432,9 +493,23 @@ def test_server_end_to_end_sigterm_drain(tmp_path):
         assert "PSD" in body["outputs"] and "X0" in body["outputs"]
 
         c = ServeClient("127.0.0.1", port)
+        # traceparent contract: a traced client's header is adopted
+        # (response echoes a traceparent in the SAME trace), an
+        # untraced client still gets a server-minted one
+        tp_in = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        code, _ = c.evaluate("spar", 4.25, 9.5, 0.0, traceparent=tp_in)
+        assert code == 200
+        tp_out = c.last_headers.get("traceparent")
+        assert tp_out and tp_out.split("-")[1] == "ab" * 16
+        assert tp_out != tp_in          # the span is the server's own
+        code, _ = c.evaluate("spar", 4.25, 9.5, 0.0)
+        assert code == 200 and c.last_headers.get("traceparent")
         code, health = c.healthz()
         assert code == 200 and health["ok"]
         assert health["serve_requests"] >= 12
+        # the SLO/window + cost-ledger blocks are part of /healthz
+        assert "window" in health and "slo" in health
+        assert "cost_ledger" in health
         code, prom = c.metrics_text()
         assert code == 200
         assert "raft_tpu_serve_requests" in prom
