@@ -963,24 +963,29 @@ def run_mode(mode):
         # no _enable_compile_cache here: the fabric coordinator never
         # touches jax — compile caching happens inside the workers
         run_fabric_bench(t_start)
-        return
-    if mode == "serve":
+    elif mode == "serve":
         run_serve_bench(t_start)
-        return
-    _enable_compile_cache()
-    from raft_tpu.obs.heartbeat import maybe_heartbeat
+    else:
+        _enable_compile_cache()
+        from raft_tpu.obs.heartbeat import maybe_heartbeat
 
-    if mode == "flat":
         with maybe_heartbeat():
-            run_flat(t_start)
-        return
-    if mode == "mixed":
-        with maybe_heartbeat():
-            run_mixed(t_start)
-        return
+            if mode == "flat":
+                run_flat(t_start)
+            elif mode == "mixed":
+                run_mixed(t_start)
+            else:
+                _run_geom(t_start)
+    # longitudinal perf trajectory (RAFT_TPU_RUNS_DIR): a COMPLETED
+    # bench mode child appends its metrics-registry picture — compile
+    # counts, stage/waste histograms, cost ledger — as a run record.
+    # Reached only on success: a crashed mode must not enter the
+    # regression-gating store looking like a complete run (the sweep
+    # and serve recorders have the same completion semantics)
+    from raft_tpu.obs import runs as obs_runs
 
-    with maybe_heartbeat():
-        _run_geom(t_start)
+    obs_runs.maybe_record("bench", label=mode,
+                          wall_s=time.perf_counter() - t_start)
 
 
 def fabric_bench_cases(n, seed=17):
@@ -1314,6 +1319,10 @@ def run_serve_bench(t_start=None):
                            if win.get("p95") is not None else None),
             window_rate_per_s=win.get("rate_per_s"),
             slo=health.get("slo"),
+            # tail attribution: per-stage latency histograms of every
+            # dispatched request — where the p95-vs-p50 gap lives
+            # (queue-wait / tick-wait / dispatch / solve / post)
+            request_stages=health.get("request_stages"),
             # device-cost ledger: per-program flops / dispatches /
             # achieved GFLOP/s from the warmed bank's sidecars
             cost_ledger=health.get("cost_ledger"),

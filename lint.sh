@@ -39,3 +39,22 @@ python -m raft_tpu.aot verify
 # fabric/serve propagation relies on
 python -m raft_tpu.obs trace --merge tests/fixtures/obs \
     -o /tmp/raft_obs_merge_check.json --check > /dev/null
+
+# perf-regression sentinel: against the checked-in baseline record,
+# the clean fixture run must PASS (exit 0) and the regressed fixture
+# (5x shard wall, dropped throughput, doubled padding waste) must be
+# CAUGHT (exit 1) — the `obs runs regress` CI contract every later
+# perf PR gates through
+python -m raft_tpu.obs runs regress tests/fixtures/runs/clean.json \
+    --baseline tests/fixtures/runs/baseline.json --check > /dev/null
+# must be EXACTLY exit 1 (regression caught) — a crash/usage error
+# (exit 2) is a broken sentinel, not a caught regression
+regress_rc=0
+python -m raft_tpu.obs runs regress tests/fixtures/runs/regressed.json \
+    --baseline tests/fixtures/runs/baseline.json --check \
+    > /dev/null 2>&1 || regress_rc=$?
+if [ "$regress_rc" -ne 1 ]; then
+    echo "lint.sh: obs runs regress exited $regress_rc on the regressed" \
+         "fixture (want 1: regression caught)" >&2
+    exit 1
+fi

@@ -1,30 +1,43 @@
 """CLI for the telemetry subsystem (pure stdlib, no jax).
 
-    python -m raft_tpu.obs report <run.jsonl>
+    python -m raft_tpu.obs report <run.jsonl> [--format json]
     python -m raft_tpu.obs report --merge <capture-dir | shard.jsonl ...>
     python -m raft_tpu.obs trace  <run.jsonl> -o trace.json
     python -m raft_tpu.obs trace  --merge <capture-dir | shards...> -o t.json
     python -m raft_tpu.obs events
     python -m raft_tpu.obs spans
+    python -m raft_tpu.obs runs   {record,list,compare,regress,ingest,pin}
 
 ``report`` prints the per-stage wall-time tree, counter table, program
-cost ledger and reliability summary of one ``RAFT_TPU_LOG`` capture;
-``trace`` exports it as Chrome/Perfetto trace-event JSON (load in
-``chrome://tracing`` or https://ui.perfetto.dev).  ``--merge`` accepts
-several per-process capture shards (or a directory of
-``trace-<pid>.jsonl`` files, the ``RAFT_TPU_LOG=<dir>`` layout) and
-assembles coordinator + workers + server onto ONE wall-clock timeline
-using the per-process ``proc_start`` clock anchors; ``--check`` (trace)
-additionally exits 1 when the merged capture has unmatched span begins
-or orphan spans (a parent id resolving to no span) — the cross-process
-propagation acceptance gate.  ``events``/``spans`` list the registered
-schemas.  Exit codes: 0 ok, 1 check failed, 2 usage/input error.
+cost ledger, serve tail-attribution and padding-waste tables and the
+reliability summary of one ``RAFT_TPU_LOG`` capture (``--format json``
+emits the same sections machine-readably); ``trace`` exports it as
+Chrome/Perfetto trace-event JSON (load in ``chrome://tracing`` or
+https://ui.perfetto.dev).  ``--merge`` accepts several per-process
+capture shards (or a directory of ``trace-<pid>.jsonl`` files, the
+``RAFT_TPU_LOG=<dir>`` layout) and assembles coordinator + workers +
+server onto ONE wall-clock timeline using the per-process
+``proc_start`` clock anchors; ``--check`` (trace) additionally exits 1
+when the merged capture has unmatched span begins or orphan spans (a
+parent id resolving to no span) — the cross-process propagation
+acceptance gate.  ``events``/``spans`` list the registered schemas.
+
+``runs`` is the longitudinal perf store (:mod:`raft_tpu.obs.runs`,
+``RAFT_TPU_RUNS_DIR``): ``record`` appends a run record from the
+current process/capture, ``list`` shows the trajectory, ``compare``
+prints per-metric deltas between two records, ``regress`` gates the
+newest record against the pinned baseline (exit 1 on regression,
+env-fingerprint mismatch downgrades to warnings), ``ingest`` imports
+``BENCH_rNN.json`` artifacts, ``pin`` chooses the baseline.
+
+Exit codes: 0 ok, 1 check/regress failed, 2 usage/input error.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -55,8 +68,14 @@ def _cmd_report(args):
     from raft_tpu.obs import report
 
     events, bad, _ = _load(args.jsonl, args.merge)
-    sys.stdout.write(report.render_report(
-        events, bad, source=", ".join(args.jsonl)))
+    if args.format == "json":
+        json.dump(report.report_data(events, bad,
+                                     source=", ".join(args.jsonl)),
+                  sys.stdout, indent=1, default=str)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(report.render_report(
+            events, bad, source=", ".join(args.jsonl)))
     return 0
 
 
@@ -103,6 +122,207 @@ def _cmd_spans(_args):
     return 0
 
 
+# ------------------------------------------------------------- runs verbs
+
+
+def _runs_store(args, need=True):
+    from raft_tpu.obs import runs
+
+    d = getattr(args, "dir", None) or runs.runs_dir()
+    if d is None and need:
+        print("no run store: set RAFT_TPU_RUNS_DIR or pass --dir",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return d
+
+
+def _cmd_runs_record(args):
+    from raft_tpu.obs import runs
+
+    events = None
+    if args.events:
+        merge = len(args.events) > 1 or os.path.isdir(args.events[0])
+        events, _bad, _ = _load(args.events, merge=merge)
+    extra = {}
+    if args.extra_json:
+        try:
+            extra = json.loads(args.extra_json)
+            if not isinstance(extra, dict):
+                raise ValueError("must be a JSON object")
+        except ValueError as e:
+            print(f"--extra-json: {e}", file=sys.stderr)
+            return 2
+    d = _runs_store(args)
+    record = runs.build_record(args.kind, label=args.label, extra=extra,
+                               events=events)
+    path = runs.write_record(record, d)
+    n = len(runs.flatten(record))
+    print(f"{path}: recorded kind={args.kind} ({n} metrics)")
+    return 0
+
+
+def _cmd_runs_list(args):
+    from raft_tpu.obs import runs
+
+    d = _runs_store(args)
+    records = runs.list_records(d)
+    if not records:
+        print(f"{d}: no run records")
+        return 0
+    pinned = runs.pinned_baseline(d)
+    import time as _time
+
+    for path, rec in records:
+        mark = "*" if pinned and os.path.samefile(path, pinned) else " "
+        t = _time.strftime("%Y-%m-%d %H:%M:%S",
+                           _time.localtime(rec.get("t_unix") or 0))
+        env = rec.get("env") or {}
+        where = ("ingested" if env.get("ingested")
+                 else f"{env.get('platform', '?')}x"
+                      f"{env.get('n_devices', '?')}")
+        print(f"{mark} {os.path.basename(path):44s} {t}  "
+              f"{rec.get('kind', '?'):12s} {str(rec.get('label') or '-'):16s} "
+              f"{where:10s} {len(runs.flatten(rec)):4d} metrics")
+    if pinned:
+        print(f"baseline: {os.path.basename(pinned)}")
+    return 0
+
+
+def _fmt_v(v):
+    return "—" if v is None else (f"{v:.6g}" if isinstance(v, float) else v)
+
+
+def _cmd_runs_compare(args):
+    from raft_tpu.obs import runs
+
+    try:
+        a = runs.load_record(args.new)
+        b = runs.load_record(args.baseline)
+    except (OSError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    rows = runs.compare_records(a, b)
+    mismatch = runs.env_mismatch(a, b)
+    print(f"compare {os.path.basename(args.new)} vs "
+          f"{os.path.basename(args.baseline)}"
+          + (f"  [env mismatch: {', '.join(mismatch)} — numbers not "
+             "directly comparable]" if mismatch else ""))
+    print(f"  {'metric':44s} {'baseline':>12s} {'new':>12s} "
+          f"{'delta':>12s} {'pct':>8s}")
+    for r in rows:
+        # keep one-sided rows (metric present in only one record):
+        # a metric DISAPPEARING is the biggest change of all
+        if args.changed_only and r.get("delta") == 0:
+            continue
+        pct = r.get("pct")
+        print(f"  {r['metric']:44s} {_fmt_v(r['base']):>12s} "
+              f"{_fmt_v(r['new']):>12s} {_fmt_v(r.get('delta')):>12s} "
+              + (f"{pct:+7.1f}%" if pct is not None else "       —"))
+    return 0
+
+
+def _cmd_runs_regress(args):
+    from raft_tpu.obs import runs
+    from raft_tpu.utils.structlog import log_event
+
+    d = getattr(args, "dir", None) or runs.runs_dir()
+    new_path = args.record
+    base_path = args.baseline
+    if new_path is None:
+        records = runs.list_records(d) if d else []
+        if not records:
+            print("regress: no record given and no records in the store",
+                  file=sys.stderr)
+            return 2
+        new_path = records[-1][0]
+        # newest-vs-baseline: never judge the baseline against itself —
+        # whether it came from the pin file or --baseline (a self-
+        # compare trivially passes and the gate would check nothing)
+        base = base_path or (runs.pinned_baseline(d) if d else None)
+        if base and os.path.exists(base) \
+                and os.path.samefile(new_path, base) and len(records) > 1:
+            new_path = records[-2][0]
+    if base_path is None:
+        base_path = runs.pinned_baseline(d) if d else None
+        if base_path is None:
+            print("regress: no baseline pinned (obs runs pin <record>) "
+                  "and no --baseline given", file=sys.stderr)
+            return 2
+    try:
+        new = runs.load_record(new_path)
+        base = runs.load_record(base_path)
+    except (OSError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    verdict = runs.regress_records(new, base, rel_tol=args.rel_tol)
+    name_new, name_base = (os.path.basename(new_path),
+                           os.path.basename(base_path))
+    print(f"regress {name_new} vs baseline {name_base}: "
+          f"{verdict['checked']} watched metrics checked")
+    if verdict["env_mismatch"]:
+        print(f"  WARNING: environment mismatch on "
+              f"{', '.join(verdict['env_mismatch'])} — numbers are not "
+              "comparable across hosts/backends; regressions downgraded "
+              "to warnings")
+    if verdict.get("kind_mismatch"):
+        print(f"  note: comparing kind={new.get('kind')!r} against "
+              f"kind={base.get('kind')!r} — only their shared metrics "
+              "are checked")
+    for r in verdict["regressions"]:
+        tag = "warning" if verdict["env_mismatch"] else "REGRESSION"
+        arrow = "↑" if r["better"] == "lower" else "↓"
+        print(f"  {tag}: {r['metric']} {arrow} {_fmt_v(r['base'])} -> "
+              f"{_fmt_v(r['new'])} (worse by {_fmt_v(r['worsening'])}, "
+              f"threshold {_fmt_v(r['threshold'])})")
+        if not verdict["env_mismatch"]:
+            log_event("regression_detected", metric=r["metric"],
+                      base=r["base"], new=r["new"],
+                      threshold=r["threshold"], baseline=name_base,
+                      record=name_new)
+    for r in verdict["improvements"]:
+        print(f"  improved: {r['metric']} {_fmt_v(r['base'])} -> "
+              f"{_fmt_v(r['new'])}")
+    if verdict["ok"]:
+        print("  ok: no regressions"
+              + (" gated (env mismatch)" if verdict["env_mismatch"]
+                 and verdict["regressions"] else ""))
+        return 0
+    print(f"  FAILED: {len(verdict['regressions'])} regression(s)",
+          file=sys.stderr)
+    return 1
+
+
+def _cmd_runs_ingest(args):
+    from raft_tpu.obs import runs
+
+    d = _runs_store(args)
+    n = 0
+    for path in args.files:
+        try:
+            record = runs.ingest_bench(path)
+        except (OSError, ValueError) as e:
+            print(f"skip {path}: {e}", file=sys.stderr)
+            continue
+        out = runs.write_record(record, d)
+        print(f"{os.path.basename(path)} -> {os.path.basename(out)} "
+              f"({len(runs.flatten(record))} metrics)")
+        n += 1
+    return 0 if n or not args.files else 2
+
+
+def _cmd_runs_pin(args):
+    from raft_tpu.obs import runs
+
+    d = _runs_store(args)
+    try:
+        pin = runs.pin_baseline(args.record, d)
+    except (OSError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    print(f"pinned {os.path.basename(args.record)} as baseline ({pin})")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="python -m raft_tpu.obs")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -114,6 +334,9 @@ def main(argv=None):
     p.add_argument("--merge", action="store_true",
                    help="assemble several per-process shards onto one "
                         "wall-clock timeline (proc_start anchors)")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="'json' emits every report section machine-"
+                        "readably (the run-record 'report' payload)")
 
     p = sub.add_parser("trace",
                        help="export a capture as Chrome trace events")
@@ -132,7 +355,60 @@ def main(argv=None):
     sub.add_parser("events", help="list the registered event schema")
     sub.add_parser("spans", help="list the registered span names")
 
+    p = sub.add_parser("runs",
+                       help="longitudinal run-record store + regression "
+                            "sentinel (RAFT_TPU_RUNS_DIR)")
+    rsub = p.add_subparsers(dest="runs_cmd", required=True)
+
+    r = rsub.add_parser("record", help="append one run record from the "
+                                       "current process state")
+    r.add_argument("--kind", default="manual")
+    r.add_argument("--label", default=None)
+    r.add_argument("--events", nargs="+", default=None,
+                   help="fold a RAFT_TPU_LOG capture's report sections "
+                        "into the record (machine-readable obs report)")
+    r.add_argument("--extra-json", default=None,
+                   help="JSON object of extra scalar metrics")
+    r.add_argument("--dir", default=None)
+
+    r = rsub.add_parser("list", help="list the stored run trajectory")
+    r.add_argument("--dir", default=None)
+
+    r = rsub.add_parser("compare", help="per-metric deltas of two records")
+    r.add_argument("new")
+    r.add_argument("baseline")
+    r.add_argument("--changed-only", action="store_true")
+
+    r = rsub.add_parser(
+        "regress",
+        help="gate a record against the pinned baseline (exit 1 on "
+             "regression; env mismatch downgrades to warnings)")
+    r.add_argument("record", nargs="?", default=None,
+                   help="record to judge (default: newest in the store)")
+    r.add_argument("--baseline", default=None,
+                   help="baseline record (default: the pinned one)")
+    r.add_argument("--dir", default=None)
+    r.add_argument("--rel-tol", type=float, default=None,
+                   help="override RAFT_TPU_RUNS_REL_TOL")
+    r.add_argument("--check", action="store_true",
+                   help="CI alias: identical gating, spelled explicitly "
+                        "in lint.sh")
+
+    r = rsub.add_parser("ingest",
+                        help="import BENCH_rNN.json artifacts as records")
+    r.add_argument("files", nargs="+")
+    r.add_argument("--dir", default=None)
+
+    r = rsub.add_parser("pin", help="pin one record as THE baseline")
+    r.add_argument("record")
+    r.add_argument("--dir", default=None)
+
     args = ap.parse_args(argv)
+    if args.cmd == "runs":
+        return {"record": _cmd_runs_record, "list": _cmd_runs_list,
+                "compare": _cmd_runs_compare, "regress": _cmd_runs_regress,
+                "ingest": _cmd_runs_ingest,
+                "pin": _cmd_runs_pin}[args.runs_cmd](args)
     return {"report": _cmd_report, "trace": _cmd_trace,
             "events": _cmd_events, "spans": _cmd_spans}[args.cmd](args)
 

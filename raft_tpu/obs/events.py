@@ -39,11 +39,13 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         "the matching span closed; error carries repr(exc) on failure"),
     "heartbeat": (
         ("devices", "live_arrays", "progress?", "worker_id?", "leases?",
-         "windows?"),
+         "windows?", "host_rss_bytes?", "host_rss_peak_bytes?"),
         "periodic device sampler: per-device memory_stats, live-buffer "
         "count, sweep shard progress (RAFT_TPU_HEARTBEAT_S); fabric "
         "workers add their id and currently-held shard leases; serving "
-        "processes add the sliding-window latency snapshots"),
+        "processes add the sliding-window latency snapshots; on Linux "
+        "each beat also carries the host process RSS/high-watermark "
+        "(/proc/self/status, no psutil)"),
     "metrics_snapshot": (
         ("snapshot",),
         "full metrics-registry snapshot (emitted at sweep_done; also "
@@ -63,9 +65,12 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         "repeat rows (dropped again on gather) — warning-level: the "
         "caller is paying for rows it did not ask for"),
     "bucket_sweep": (
-        ("rows", "n_buckets", "n_designs", "padding_waste_frac"),
+        ("rows", "n_buckets", "n_designs", "padding_waste_frac",
+         "waste_by_axis?"),
         "heterogeneous sweep dispatched: designs auto-binned into "
-        "shape buckets, one compiled program per bucket"),
+        "shape buckets, one compiled program per bucket; waste_by_axis "
+        "decomposes the row-weighted padding waste per padded axis "
+        "(strips/nodes/lines/rows)"),
     "sweep_start": (
         ("out_dir", "n_cases", "n_shards", "shard_size", "out_keys",
          "mesh_shape"),
@@ -205,9 +210,28 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         "one request resolved slower than RAFT_TPU_SERVE_SLO_MS "
         "(counted in serve_slo_breaches; /healthz reports both next "
         "to the sliding-window p50/p95)"),
+    "serve_request_stages": (
+        ("wall_s", "queue_wait_s", "tick_wait_s", "dispatch_s",
+         "solve_s", "post_s", "escalated?"),
+        "per-resolved-request latency decomposition into named stages "
+        "(admission-queue wait, in-tick wait behind earlier groups, "
+        "dispatch overhead, compiled-program solve, post/cache fan-"
+        "out); the stages sum to wall_s by construction — `obs report` "
+        "renders the p50-vs-p95 stage table from these"),
     "serve_stop": (
         ("requests", "wall_s"),
         "the service exited after draining and flushing metrics"),
+    # --------------------------------------------- run-record store
+    "run_record": (
+        ("kind", "path", "label?"),
+        "one schema-versioned run record was appended to the "
+        "RAFT_TPU_RUNS_DIR store (raft_tpu.obs.runs) — the "
+        "longitudinal perf-trajectory entry a later `obs runs "
+        "regress` compares against the pinned baseline"),
+    "regression_detected": (
+        ("metric", "base", "new", "threshold", "baseline", "record"),
+        "`obs runs regress` found one watched metric worse than the "
+        "pinned baseline past its noise threshold (the CLI exits 1)"),
     # ------------------------------------------------- AOT program bank
     "aot_load": (
         ("kind", "key", "bytes", "wall_s"),

@@ -24,6 +24,7 @@ jax access is wrapped, and failures are reported in-band on the event.
 from __future__ import annotations
 
 import contextlib
+import re
 import threading
 
 from raft_tpu.obs import metrics
@@ -32,6 +33,25 @@ from raft_tpu.utils.structlog import log_event
 
 _MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
              "largest_free_block_bytes")
+
+
+def sample_host_rss():
+    """``(rss_bytes, peak_bytes)`` of THIS process from
+    ``/proc/self/status`` (``VmRSS``/``VmHWM`` — no psutil dependency):
+    the host-side memory picture device ``memory_stats()`` cannot see
+    (packed design pytrees, result caches, the CPU backend's arrays all
+    live in host RSS).  ``(None, None)`` on non-Linux hosts."""
+    try:
+        with open("/proc/self/status") as f:
+            text = f.read()
+    except OSError:
+        return None, None
+
+    def field(name):
+        m = re.search(rf"^{name}:\s+(\d+)\s*kB", text, re.MULTILINE)
+        return int(m.group(1)) * 1024 if m else None
+
+    return field("VmRSS"), field("VmHWM")
 
 
 def sample_devices(devices=None):
@@ -101,6 +121,16 @@ class Heartbeat(threading.Thread):
         if live is not None:
             metrics.gauge("live_arrays").set(live)
         kw = {}
+        # host-process RSS next to the device picture: the gauges' high
+        # watermarks survive into the metrics snapshot, so run records
+        # capture peak host memory alongside device memory_stats
+        rss, hwm = sample_host_rss()
+        if rss is not None:
+            metrics.gauge("host_rss_bytes").set(rss)
+            kw["host_rss_bytes"] = rss
+        if hwm is not None:
+            metrics.gauge("host_rss_peak_bytes").set(hwm)
+            kw["host_rss_peak_bytes"] = hwm
         # same window length the live /healthz endpoint reports, so a
         # captured beat and a concurrent scrape agree on the SLO view
         wins = metrics.sample_windows(

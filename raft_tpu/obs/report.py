@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 
 
 def expand_captures(paths):
@@ -186,9 +187,111 @@ def _fmt_s(v):
     return f"{v:9.3f}s" if v is not None else "        —"
 
 
-def render_report(events, n_bad=0, source="<events>"):
-    """Human-readable report (string) over one capture."""
-    out = []
+#: the serve tail-attribution stage names, in pipeline order (the
+#: batcher emits one ``serve_request_stages`` event per resolved
+#: dispatched request with ``<stage>_s`` fields summing to wall_s)
+SERVE_STAGES = ("queue_wait", "tick_wait", "dispatch", "solve", "post")
+
+
+def serve_stage_attribution(events):
+    """The p50-vs-p95 latency decomposition of the dispatched serve
+    requests in a capture, or None when no ``serve_request_stages``
+    events are present.
+
+    Rather than reporting each stage's independent percentile (whose
+    sum can exceed the total's percentile arbitrarily), the p50/p95
+    columns show the stage breakdown of the *request at that rank of
+    total latency* — stages then sum to that request's measured
+    end-to-end latency by construction, so "p95 is 4.5x p50" reads
+    directly as "the p95 request spent X ms in tick-wait"."""
+    reqs = [e for e in events if e["event"] == "serve_request_stages"]
+    if not reqs:
+        return None
+
+    def stages_of(e):
+        return {s: float(e.get(f"{s}_s") or 0.0) for s in SERVE_STAGES}
+
+    reqs.sort(key=lambda e: float(e.get("wall_s") or 0.0))
+
+    def at_rank(p):
+        i = min(len(reqs) - 1, max(0, round(p * (len(reqs) - 1))))
+        e = reqs[i]
+        st = stages_of(e)
+        return {"total_s": round(float(e.get("wall_s") or 0.0), 6),
+                "stages": {k: round(v, 6) for k, v in st.items()},
+                "stages_sum_s": round(sum(st.values()), 6)}
+
+    n = len(reqs)
+    mean_stages = {s: round(sum(stages_of(e)[s] for e in reqs) / n, 6)
+                   for s in SERVE_STAGES}
+    return {
+        "n_requests": n,
+        "mean": {"total_s": round(sum(float(e.get("wall_s") or 0.0)
+                                      for e in reqs) / n, 6),
+                 "stages": mean_stages},
+        "p50": at_rank(0.50),
+        "p95": at_rank(0.95),
+    }
+
+
+def waste_axes_from_counters(counters):
+    """``{axis: {valid, padded, waste_frac}}`` from the exact
+    ``pad_valid_<axis>`` / ``pad_total_<axis>`` counter pairs a
+    dispatch records — the single definition of the counter→waste
+    derivation (the report table AND the run store's ``waste:*``
+    regress metrics both go through here, so they cannot diverge)."""
+    axes = {}
+    for name, total in (counters or {}).items():
+        m = re.fullmatch(r"pad_total_(\w+)", name)
+        if m and total:
+            axis = m.group(1)
+            valid = counters.get(f"pad_valid_{axis}", 0)
+            axes[axis] = {"valid": int(valid), "padded": int(total),
+                          "waste_frac": round(1.0 - valid / total, 6)}
+    return axes
+
+
+def waste_attribution(events, snapshot=None):
+    """Per-axis padding-waste decomposition, or None when the capture
+    carries no waste instrumentation.
+
+    Sources, in preference order: the final metrics snapshot's exact
+    ``pad_valid_<axis>`` / ``pad_total_<axis>`` counter pairs (summed
+    over every dispatched row — the strips axis reproduces the
+    aggregate row-weighted ``padding_waste_frac`` bit-for-bit), else
+    the ``bucket_sweep`` events' ``waste_by_axis`` payloads.  The
+    per-row distribution (mean/p95 of each row's own pad fraction)
+    joins from the ``pad_waste_<axis>`` histograms when present."""
+    counters = (snapshot or {}).get("counters") or {}
+    hists = (snapshot or {}).get("histograms") or {}
+    axes = waste_axes_from_counters(counters)
+    if not axes:
+        for e in events:
+            if e["event"] != "bucket_sweep" or not e.get("waste_by_axis"):
+                continue
+            for axis, rec in e["waste_by_axis"].items():
+                a = axes.setdefault(axis, {"valid": 0, "padded": 0})
+                a["valid"] += int(rec.get("valid") or 0)
+                a["padded"] += int(rec.get("padded") or 0)
+        for a in axes.values():
+            a["waste_frac"] = (round(1.0 - a["valid"] / a["padded"], 6)
+                               if a["padded"] else 0.0)
+    if not axes:
+        return None
+    for axis, a in axes.items():
+        h = hists.get(f"pad_waste_{axis}") or {}
+        if h.get("count"):
+            a["rows"] = h["count"]
+            a["row_mean"] = h.get("mean")
+            a["row_p95"] = h.get("p95")
+    return {"axes": axes}
+
+
+def report_data(events, n_bad=0, source="<events>"):
+    """Machine-readable report: every section of :func:`render_report`
+    as one JSON-ready dict (``obs report --format json``; embedded
+    verbatim in run records by ``obs runs record --events`` instead of
+    anyone re-parsing rendered text)."""
     run_ids = sorted({e.get("run_id") for e in events if e.get("run_id")})
     # per-pid windows summed: `t` is monotonic per process, so a
     # resume-appended capture spans several clocks
@@ -197,76 +300,39 @@ def render_report(events, n_bad=0, source="<events>"):
         lo, hi = pids.get(e.get("pid") or 1, (e["t"], e["t"]))
         pids[e.get("pid") or 1] = (min(lo, e["t"]), max(hi, e["t"]))
     window = sum(hi - lo for lo, hi in pids.values())
-    out.append(f"telemetry report — {source}")
-    out.append(f"  {len(events)} events"
-               + (f" ({n_bad} unparseable lines skipped)" if n_bad else "")
-               + f", window {window:.3f}s"
-               + (f" across {len(pids)} process(es)" if len(pids) > 1 else "")
-               + f", run_id(s): {', '.join(run_ids) or '—'}")
 
     spans, unmatched = collect_spans(events)
-    if spans or unmatched:
-        out.append("")
-        out.append("span wall-time tree"
-                   + (f"  [{len(unmatched)} unmatched begin(s) — "
-                      "process died mid-span]" if unmatched else ""))
-        out.append(f"  {'':38s} {'count':>6s} {'total':>10s} "
-                   f"{'p50':>10s} {'p95':>10s} {'max':>10s}")
-        paths, fails = span_paths(spans)
-        # plain tuple sort = depth-first tree order (a child path sorts
-        # immediately after its parent prefix)
-        for p in sorted(paths):
-            walls = paths[p]
-            label = "  " * (len(p) - 1) + p[-1]
-            nfail = fails.get(p, 0)
-            out.append(
-                f"  {label:38s} {len(walls):6d} {_fmt_s(sum(walls))} "
-                f"{_fmt_s(_percentile(walls, 0.50))} "
-                f"{_fmt_s(_percentile(walls, 0.95))} "
-                f"{_fmt_s(max(walls))}"
-                + (f"   [{nfail} failed]" if nfail else ""))
+    paths, fails = span_paths(spans)
+    # plain tuple sort = depth-first tree order (a child path sorts
+    # immediately after its parent prefix)
+    span_rows = []
+    for p in sorted(paths):
+        walls = paths[p]
+        span_rows.append({
+            "path": list(p), "count": len(walls),
+            "total_s": round(sum(walls), 6),
+            "p50_s": _percentile(walls, 0.50),
+            "p95_s": _percentile(walls, 0.95),
+            "max_s": max(walls),
+            "failed": fails.get(p, 0)})
 
     # legacy flat stage timings (structlog.stage emits the stage name
     # as the event, with wall_s)
     legacy = {}
     for e in events:
         if "wall_s" in e and e["event"] not in (
-                "span_end", "shard_done", "sweep_done"):
+                "span_end", "shard_done", "sweep_done",
+                "serve_request_stages"):
             legacy.setdefault(e["event"], []).append(e["wall_s"])
-    if legacy:
-        out.append("")
-        out.append("flat stage timings (structlog.stage)")
-        for name, walls in sorted(legacy.items()):
-            out.append(
-                f"  {name:38s} {len(walls):6d} {_fmt_s(sum(walls))} "
-                f"{_fmt_s(_percentile(walls, 0.50))} "
-                f"{_fmt_s(_percentile(walls, 0.95))} "
-                f"{_fmt_s(max(walls))}")
+    stage_rows = [
+        {"name": name, "count": len(walls),
+         "total_s": round(sum(walls), 6),
+         "p50_s": _percentile(walls, 0.50),
+         "p95_s": _percentile(walls, 0.95), "max_s": max(walls)}
+        for name, walls in sorted(legacy.items())]
 
     snaps = [e for e in events if e["event"] == "metrics_snapshot"]
-    if snaps:
-        snap = snaps[-1].get("snapshot", {})
-        counters = snap.get("counters", {})
-        if counters:
-            out.append("")
-            out.append("counters (final metrics snapshot)")
-            for name, v in sorted(counters.items()):
-                out.append(f"  {name:38s} {v}")
-        gauges = snap.get("gauges", {})
-        if gauges:
-            out.append("")
-            out.append("gauges (value / high watermark)")
-            for name, g in sorted(gauges.items()):
-                out.append(f"  {name:38s} {g.get('value')} / {g.get('max')}")
-        hists = {k: h for k, h in snap.get("histograms", {}).items()
-                 if h.get("count")}
-        if hists:
-            out.append("")
-            out.append("histograms (count / mean / p50 / p95 / max)")
-            for name, h in sorted(hists.items()):
-                out.append(
-                    f"  {name:38s} {h['count']:6d}  {h.get('mean')}  "
-                    f"{h.get('p50')}  {h.get('p95')}  {h.get('max')}")
+    snapshot = snaps[-1].get("snapshot", {}) if snaps else {}
 
     # fabric per-worker table: every record a worker emits is stamped
     # worker=<id> (RAFT_TPU_WORKER_ID via structlog), so one shared
@@ -286,19 +352,14 @@ def render_report(events, n_bad=0, source="<events>"):
             rec["steals"] += 1
         elif e["event"] == "shard_resume":
             rec["resumes"] += 1
-    if any(r["claims"] or r["walls"] for r in workers.values()):
-        out.append("")
-        out.append("fabric workers (shards / claims / steals / resumes / "
-                   "total / p50 / p95)")
-        for w in sorted(workers):
-            r = workers[w]
-            walls = r["walls"]
-            out.append(
-                f"  {w:20s} {len(walls):6d} {r['claims']:6d} "
-                f"{r['steals']:6d} {r['resumes']:7d} "
-                f"{_fmt_s(sum(walls) if walls else None)} "
-                f"{_fmt_s(_percentile(walls, 0.50))} "
-                f"{_fmt_s(_percentile(walls, 0.95))}")
+    worker_rows = [
+        {"worker": w, "shards": len(r["walls"]), "claims": r["claims"],
+         "steals": r["steals"], "resumes": r["resumes"],
+         "total_s": round(sum(r["walls"]), 6) if r["walls"] else None,
+         "p50_s": _percentile(r["walls"], 0.50),
+         "p95_s": _percentile(r["walls"], 0.95)}
+        for w, r in sorted(workers.items())
+        if r["claims"] or r["walls"]]
 
     # evaluation-service table: per-endpoint request/latency rows from
     # serve_request events, batch occupancy from serve_tick events
@@ -311,32 +372,24 @@ def render_report(events, n_bad=0, source="<events>"):
         rec["walls"].append(e.get("wall_s") or 0.0)
         if e.get("cache_hit"):
             rec["hits"] += 1
+    endpoint_rows = [
+        {"endpoint": ep, "code": code, "requests": len(rec["walls"]),
+         "cache_hits": rec["hits"],
+         "p50_s": _percentile(rec["walls"], 0.50),
+         "p95_s": _percentile(rec["walls"], 0.95),
+         "max_s": max(rec["walls"])}
+        for (ep, code), rec in sorted(endpoints.items())]
     ticks = [e for e in events if e["event"] == "serve_tick"]
-    if endpoints or ticks:
-        out.append("")
-        out.append("serve endpoints (endpoint / code / requests / "
-                   "cache hits / p50 / p95 / max)")
-        for (ep, code) in sorted(endpoints):
-            rec = endpoints[(ep, code)]
-            walls = rec["walls"]
-            out.append(
-                f"  {ep:24s} {code:4d} {len(walls):8d} {rec['hits']:8d} "
-                f"{_fmt_s(_percentile(walls, 0.50))} "
-                f"{_fmt_s(_percentile(walls, 0.95))} "
-                f"{_fmt_s(max(walls))}")
-        if ticks:
-            rows = [e.get("rows") or 0 for e in ticks]
-            uniq = [e.get("unique") or 0 for e in ticks]
-            disp = sum(e.get("dispatches") or 0 for e in ticks)
-            walls = [e.get("wall_s") or 0.0 for e in ticks]
-            # occupancy vs the padded program sizes lives in the
-            # serve_batch_occupancy histogram (metrics snapshot above);
-            # this line is the tick-level view of the same batching
-            out.append(
-                f"  ticks: {len(ticks)} ({sum(rows)} requests, "
-                f"{sum(uniq)} unique rows, {disp} dispatches; "
-                f"mean batch {sum(rows) / len(ticks):.1f}, "
-                f"tick p95 {_percentile(walls, 0.95):.3f}s)")
+    tick_summary = None
+    if ticks:
+        rows = [e.get("rows") or 0 for e in ticks]
+        walls = [e.get("wall_s") or 0.0 for e in ticks]
+        tick_summary = {
+            "ticks": len(ticks), "requests": sum(rows),
+            "unique_rows": sum(e.get("unique") or 0 for e in ticks),
+            "dispatches": sum(e.get("dispatches") or 0 for e in ticks),
+            "mean_batch": round(sum(rows) / len(ticks), 2),
+            "p95_s": _percentile(walls, 0.95)}
 
     # device-cost ledger: one row per banked/compiled program, joined
     # from program_cost (flops, at load/store) and program_dispatch
@@ -359,21 +412,18 @@ def render_report(events, n_bad=0, source="<events>"):
             rec.setdefault("kind", e.get("kind"))
             rec["dispatches"] += 1
             rec["wall_s"] += e.get("wall_s") or 0.0
+    occupancy = None
+    ledger_rows = []
     if progs:
-        occupancy = None
-        if snaps:
-            occ = (snaps[-1].get("snapshot", {}).get("histograms", {})
-                   .get("serve_batch_occupancy") or {})
-            occupancy = occ.get("mean")
+        occ = (snapshot.get("histograms", {})
+               .get("serve_batch_occupancy") or {})
+        occupancy = occ.get("mean")
         if occupancy is None:
             wastes = [e["padding_waste_frac"] for e in events
                       if e["event"] == "bucket_sweep"
                       and e.get("padding_waste_frac") is not None]
             if wastes:
                 occupancy = 1.0 - sum(wastes) / len(wastes)
-        out.append("")
-        out.append("program cost ledger (key / kind / GFLOP / dispatches "
-                   "/ achieved GFLOP/s / effective)")
         for key in sorted(progs):
             rec = progs[key]
             flops = rec.get("flops")
@@ -382,23 +432,16 @@ def render_report(events, n_bad=0, source="<events>"):
                       else None)
             eff = (gflops * occupancy
                    if gflops is not None and occupancy is not None else None)
-            out.append(
-                f"  {key:26s} {str(rec.get('kind') or '?'):12s} "
-                + (f"{flops / 1e9:10.3f}" if flops else "         —")
-                + f" {rec['dispatches']:6d} "
-                + (f"{gflops:10.2f}" if gflops is not None else "         —")
-                + (f" {eff:10.2f}" if eff is not None else "          —"))
-        if occupancy is not None:
-            out.append(f"  (effective = achieved x mean batch occupancy "
-                       f"{occupancy:.3f})")
+            ledger_rows.append({
+                "key": key, "kind": rec.get("kind"), "flops": flops,
+                "dispatches": rec["dispatches"],
+                "gflops_s": round(gflops, 4) if gflops is not None else None,
+                "effective_gflops_s": (round(eff, 4)
+                                       if eff is not None else None)})
 
     counts = {}
     for e in events:
         counts[e["event"]] = counts.get(e["event"], 0) + 1
-    out.append("")
-    out.append("event counts")
-    for name, n in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])):
-        out.append(f"  {name:38s} {n:6d}")
 
     # reliability summary: the "what fraction was retried/flagged/
     # escalated" question, straight from the event stream
@@ -407,28 +450,227 @@ def render_report(events, n_bad=0, source="<events>"):
     quar = [e for e in events if e["event"] == "shard_quarantine"]
     esc = [e for e in events if e["event"] == "shard_escalate"]
     done = [e for e in events if e["event"] == "sweep_done"]
+    reliability = None
     if retries or ooms or quar or esc or done:
+        reasons = {}
+        for e in quar:
+            r = str(e.get("reason") or "?")
+            reasons[r] = reasons.get(r, 0) + 1
+        reliability = {
+            "retries": len(retries),
+            "retry_shards": sorted({e.get("shard") for e in retries}),
+            "oom_splits": len(ooms),
+            "quarantine_judgements": len(quar),
+            "quarantine_recovered": sum(1 for e in quar
+                                        if e.get("recovered")),
+            "quarantine_reasons": reasons,
+            "escalation_rungs": len(esc),
+            "escalations_resolved": sum(1 for e in esc
+                                        if e.get("resolved")),
+            "sweeps_done": [
+                {"n_cases": e.get("n_cases"),
+                 "n_quarantined": e.get("n_quarantined"),
+                 "n_flagged": e.get("n_flagged"),
+                 "wall_s": e.get("wall_s")} for e in done]}
+
+    return {
+        "source": source,
+        "meta": {"events": len(events), "bad_lines": n_bad,
+                 "window_s": round(window, 6), "processes": len(pids),
+                 "run_ids": run_ids},
+        "spans": {"unmatched": len(unmatched), "paths": span_rows},
+        "stages": stage_rows,
+        "snapshot": snapshot,
+        "workers": worker_rows,
+        "serve": ({"endpoints": endpoint_rows, "ticks": tick_summary}
+                  if endpoint_rows or ticks else None),
+        "serve_stages": serve_stage_attribution(events),
+        "cost_ledger": ({"occupancy": occupancy, "programs": ledger_rows}
+                        if ledger_rows else None),
+        "waste": waste_attribution(events, snapshot),
+        "event_counts": counts,
+        "reliability": reliability,
+    }
+
+
+def render_report(events, n_bad=0, source="<events>"):
+    """Human-readable report (string) over one capture — the text
+    rendering of :func:`report_data`."""
+    data = report_data(events, n_bad, source)
+    meta = data["meta"]
+    out = []
+    out.append(f"telemetry report — {source}")
+    out.append(f"  {meta['events']} events"
+               + (f" ({n_bad} unparseable lines skipped)" if n_bad else "")
+               + f", window {meta['window_s']:.3f}s"
+               + (f" across {meta['processes']} process(es)"
+                  if meta["processes"] > 1 else "")
+               + f", run_id(s): {', '.join(meta['run_ids']) or '—'}")
+
+    span_rows = data["spans"]["paths"]
+    unmatched = data["spans"]["unmatched"]
+    if span_rows or unmatched:
+        out.append("")
+        out.append("span wall-time tree"
+                   + (f"  [{unmatched} unmatched begin(s) — "
+                      "process died mid-span]" if unmatched else ""))
+        out.append(f"  {'':38s} {'count':>6s} {'total':>10s} "
+                   f"{'p50':>10s} {'p95':>10s} {'max':>10s}")
+        for r in span_rows:
+            label = "  " * (len(r["path"]) - 1) + r["path"][-1]
+            out.append(
+                f"  {label:38s} {r['count']:6d} {_fmt_s(r['total_s'])} "
+                f"{_fmt_s(r['p50_s'])} {_fmt_s(r['p95_s'])} "
+                f"{_fmt_s(r['max_s'])}"
+                + (f"   [{r['failed']} failed]" if r["failed"] else ""))
+
+    if data["stages"]:
+        out.append("")
+        out.append("flat stage timings (structlog.stage)")
+        for r in data["stages"]:
+            out.append(
+                f"  {r['name']:38s} {r['count']:6d} {_fmt_s(r['total_s'])} "
+                f"{_fmt_s(r['p50_s'])} {_fmt_s(r['p95_s'])} "
+                f"{_fmt_s(r['max_s'])}")
+
+    snap = data["snapshot"]
+    counters = snap.get("counters", {})
+    if counters:
+        out.append("")
+        out.append("counters (final metrics snapshot)")
+        for name, v in sorted(counters.items()):
+            out.append(f"  {name:38s} {v}")
+    gauges = snap.get("gauges", {})
+    if gauges:
+        out.append("")
+        out.append("gauges (value / high watermark)")
+        for name, g in sorted(gauges.items()):
+            out.append(f"  {name:38s} {g.get('value')} / {g.get('max')}")
+    hists = {k: h for k, h in snap.get("histograms", {}).items()
+             if h.get("count")}
+    if hists:
+        out.append("")
+        out.append("histograms (count / mean / p50 / p95 / max)")
+        for name, h in sorted(hists.items()):
+            out.append(
+                f"  {name:38s} {h['count']:6d}  {h.get('mean')}  "
+                f"{h.get('p50')}  {h.get('p95')}  {h.get('max')}")
+
+    if data["workers"]:
+        out.append("")
+        out.append("fabric workers (shards / claims / steals / resumes / "
+                   "total / p50 / p95)")
+        for r in data["workers"]:
+            out.append(
+                f"  {r['worker']:20s} {r['shards']:6d} {r['claims']:6d} "
+                f"{r['steals']:6d} {r['resumes']:7d} "
+                f"{_fmt_s(r['total_s'])} "
+                f"{_fmt_s(r['p50_s'])} "
+                f"{_fmt_s(r['p95_s'])}")
+
+    serve = data["serve"]
+    if serve:
+        out.append("")
+        out.append("serve endpoints (endpoint / code / requests / "
+                   "cache hits / p50 / p95 / max)")
+        for r in serve["endpoints"]:
+            out.append(
+                f"  {r['endpoint']:24s} {r['code']:4d} {r['requests']:8d} "
+                f"{r['cache_hits']:8d} "
+                f"{_fmt_s(r['p50_s'])} "
+                f"{_fmt_s(r['p95_s'])} "
+                f"{_fmt_s(r['max_s'])}")
+        t = serve["ticks"]
+        if t:
+            # occupancy vs the padded program sizes lives in the
+            # serve_batch_occupancy histogram (metrics snapshot above);
+            # this line is the tick-level view of the same batching
+            out.append(
+                f"  ticks: {t['ticks']} ({t['requests']} requests, "
+                f"{t['unique_rows']} unique rows, "
+                f"{t['dispatches']} dispatches; "
+                f"mean batch {t['mean_batch']:.1f}, "
+                f"tick p95 {t['p95_s']:.3f}s)")
+
+    attrib = data["serve_stages"]
+    if attrib:
+        out.append("")
+        out.append(f"serve tail attribution ({attrib['n_requests']} "
+                   "dispatched requests; p50/p95 columns are the stage "
+                   "breakdown of the request at that latency rank)")
+        out.append(f"  {'stage':24s} {'p50':>10s} {'p95':>10s} "
+                   f"{'mean':>10s}")
+        for stage in SERVE_STAGES:
+            out.append(
+                f"  {stage:24s} "
+                f"{_fmt_s(attrib['p50']['stages'].get(stage))} "
+                f"{_fmt_s(attrib['p95']['stages'].get(stage))} "
+                f"{_fmt_s(attrib['mean']['stages'].get(stage))}")
+        out.append(
+            f"  {'total (measured)':24s} "
+            f"{_fmt_s(attrib['p50']['total_s'])} "
+            f"{_fmt_s(attrib['p95']['total_s'])} "
+            f"{_fmt_s(attrib['mean']['total_s'])}")
+
+    waste = data["waste"]
+    if waste:
+        out.append("")
+        out.append("padding waste by axis (valid / padded / waste "
+                   "/ row mean / row p95)")
+        for axis, a in sorted(waste["axes"].items()):
+            out.append(
+                f"  {axis:16s} {a['valid']:10d} {a['padded']:10d} "
+                f"{a['waste_frac']:8.4f}"
+                + (f" {a['row_mean']:9.4f}" if a.get("row_mean") is not None
+                   else "         —")
+                + (f" {a['row_p95']:9.4f}" if a.get("row_p95") is not None
+                   else "         —"))
+
+    ledger = data["cost_ledger"]
+    if ledger:
+        out.append("")
+        out.append("program cost ledger (key / kind / GFLOP / dispatches "
+                   "/ achieved GFLOP/s / effective)")
+        for r in ledger["programs"]:
+            flops = r["flops"]
+            out.append(
+                f"  {r['key']:26s} {str(r.get('kind') or '?'):12s} "
+                + (f"{flops / 1e9:10.3f}" if flops else "         —")
+                + f" {r['dispatches']:6d} "
+                + (f"{r['gflops_s']:10.2f}" if r["gflops_s"] is not None
+                   else "         —")
+                + (f" {r['effective_gflops_s']:10.2f}"
+                   if r["effective_gflops_s"] is not None else "          —"))
+        if ledger["occupancy"] is not None:
+            out.append(f"  (effective = achieved x mean batch occupancy "
+                       f"{ledger['occupancy']:.3f})")
+
+    out.append("")
+    out.append("event counts")
+    for name, n in sorted(data["event_counts"].items(),
+                          key=lambda kv: (-kv[1], kv[0])):
+        out.append(f"  {name:38s} {n:6d}")
+
+    rel = data["reliability"]
+    if rel:
         out.append("")
         out.append("reliability summary")
-        if retries:
-            out.append(f"  retries: {len(retries)} "
-                       f"(shards {sorted({e.get('shard') for e in retries})})")
-        if ooms:
-            out.append(f"  oom splits: {len(ooms)}")
-        if quar:
-            rec = sum(1 for e in quar if e.get("recovered"))
-            out.append(f"  quarantine judgements: {len(quar)} "
-                       f"({rec} recovered, {len(quar) - rec} kept bad)")
-            reasons = {}
-            for e in quar:
-                r = str(e.get("reason") or "?")
-                reasons[r] = reasons.get(r, 0) + 1
-            for r, n in sorted(reasons.items(), key=lambda kv: -kv[1]):
+        if rel["retries"]:
+            out.append(f"  retries: {rel['retries']} "
+                       f"(shards {rel['retry_shards']})")
+        if rel["oom_splits"]:
+            out.append(f"  oom splits: {rel['oom_splits']}")
+        if rel["quarantine_judgements"]:
+            nq, nr = rel["quarantine_judgements"], rel["quarantine_recovered"]
+            out.append(f"  quarantine judgements: {nq} "
+                       f"({nr} recovered, {nq - nr} kept bad)")
+            for r, n in sorted(rel["quarantine_reasons"].items(),
+                               key=lambda kv: -kv[1]):
                 out.append(f"    reason {r}: {n}")
-        if esc:
-            res = sum(1 for e in esc if e.get("resolved"))
-            out.append(f"  escalation rungs: {len(esc)} ({res} resolved)")
-        for e in done:
+        if rel["escalation_rungs"]:
+            out.append(f"  escalation rungs: {rel['escalation_rungs']} "
+                       f"({rel['escalations_resolved']} resolved)")
+        for e in rel["sweeps_done"]:
             out.append(
                 f"  sweep_done: {e.get('n_cases')} cases, "
                 f"{e.get('n_quarantined')} quarantined, "

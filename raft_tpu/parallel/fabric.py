@@ -1088,6 +1088,18 @@ def assemble(out_dir, spec=None, wall_s=None):
               n_quarantined=n_quarantined, n_flagged=n_flagged,
               wall_s=round(wall_s if wall_s is not None
                            else time.perf_counter() - t0, 3))
+    # longitudinal run record for the fabric-assembled sweep, same as
+    # the serial path (the coordinator's registry now holds the folded
+    # worker counters + the pooled shard-wall histogram)
+    from raft_tpu.obs import runs as obs_runs
+
+    obs_runs.maybe_record(
+        "sweep", label=os.path.basename(os.path.normpath(out_dir)),
+        wall_s=(wall_s if wall_s is not None
+                else time.perf_counter() - t0),
+        extra={"n_cases": n_cases, "n_shards": n_shards,
+               "n_workers": len(states), "n_quarantined": n_quarantined,
+               "n_flagged": n_flagged})
     return {k: np.concatenate([r[k] for r in results]) for k in out_keys}
 
 

@@ -707,6 +707,11 @@ def evaluate_shard(compute, chunk, shard, offset, mesh, max_retries=3,
     with span("shard", shard=shard, rows=rows):
         log_event("shard_start", shard=shard, rows=rows)
         t_sh = time.perf_counter()
+        if faults.take("delay", "shard_eval"):
+            # deliberately slowed dispatch (fixed 0.25 s): the drill
+            # the perf-regression sentinel (`obs runs regress`) must
+            # catch as a shard_wall_s / span-histogram regression
+            time.sleep(0.25)
         out = eval_with_recovery(
             lambda c: {k: np.asarray(v)[: len(next(iter(c.values())))]
                        for k, v in compute(c, mesh).items()},
@@ -883,6 +888,16 @@ def run_checkpointed(compute, cases, out_dir, shard_size, mesh, out_keys,
         log_event("sweep_done", out_dir=out_dir, n_cases=n,
                   n_quarantined=n_quarantined, n_flagged=n_flagged,
                   wall_s=round(time.perf_counter() - t0, 3))
+        # longitudinal perf trajectory: one schema-versioned run record
+        # per sweep session when RAFT_TPU_RUNS_DIR is set (no-op and
+        # never fatal otherwise) — what `obs runs regress` gates on
+        from raft_tpu.obs import runs as obs_runs
+
+        obs_runs.maybe_record(
+            "sweep", label=os.path.basename(os.path.normpath(out_dir)),
+            wall_s=time.perf_counter() - t0,
+            extra={"n_cases": n, "n_shards": n_shards,
+                   "n_quarantined": n_quarantined, "n_flagged": n_flagged})
     return {k: np.concatenate([r[k] for r in results]) for k in out_keys}
 
 

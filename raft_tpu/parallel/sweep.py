@@ -381,7 +381,9 @@ def sweep_heterogeneous(models, Hs, Tp, beta, mesh=None,
         ent = packed_by_model.get(id(m))
         if ent is None:
             sig = bucketing.bucket_signature(m)
-            ent = packed_by_model[id(m)] = (sig, bucketing.pack_design(m, sig))
+            ent = packed_by_model[id(m)] = (
+                sig, bucketing.pack_design(m, sig),
+                bucketing.axis_counts(m, sig))
         row_sigs.append(ent[0])
     w_grids = {tuple(bucketing.signature_meta(s)["w"])
                for s in set(row_sigs)}
@@ -408,6 +410,7 @@ def sweep_heterogeneous(models, Hs, Tp, beta, mesh=None,
 
     sharding = NamedSharding(mesh, P("dp"))
     out = {}
+    n_row_pad = 0
     for sig, idxs in groups.items():
         ev = bucketing.get_bucket_evaluator(sig)
         if cap and len(idxs) > cap:
@@ -418,6 +421,7 @@ def sweep_heterogeneous(models, Hs, Tp, beta, mesh=None,
             rows = len(chunk)
             pad = (cap - rows) if len(chunks) > 1 else \
                 _autopad_rows(rows, mesh)
+            n_row_pad += pad
             take = chunk + [chunk[-1]] * pad
             design = bucketing.stack_packed(
                 [packed_by_model[id(models[i])][1] for i in chunk],
@@ -456,11 +460,18 @@ def sweep_heterogeneous(models, Hs, Tp, beta, mesh=None,
     # waste is ROW-weighted (one packed entry per dispatched row, the
     # README definition and what bench.py reports), not per distinct
     # design — 990 floor-bucket rows + 10 big-semi rows must not log
-    # the unweighted 2-design mean
+    # the unweighted 2-design mean.  The per-axis decomposition rides
+    # the same rows: strips reproduce padding_waste_frac exactly,
+    # nodes/lines/batch-rows name where the rest of the pad budget
+    # goes (counters + per-row histograms; run records carry them)
+    row_axes = [packed_by_model[id(m)][2] for m in models]
+    bucketing.observe_axis_waste(row_axes, rows_valid=n,
+                                 rows_padded=n + n_row_pad)
     log_event("bucket_sweep", rows=n, n_buckets=len(groups),
               n_designs=len(packed_by_model),
               padding_waste_frac=round(bucketing.padding_waste_frac(
-                  [packed_by_model[id(m)][1] for m in models]), 4))
+                  [packed_by_model[id(m)][1] for m in models]), 4),
+              waste_by_axis=bucketing.waste_by_axis(row_axes))
     metrics.counter("bucket_sweeps").inc()
     return out
 

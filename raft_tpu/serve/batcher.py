@@ -78,7 +78,8 @@ class Draining(RejectError):
 
 class _Request:
     __slots__ = ("entry", "Hs", "Tp", "beta", "out_keys", "escalate_f64",
-                 "client", "future", "t_submit", "cache_key", "trace_ctx")
+                 "client", "future", "t_submit", "cache_key", "trace_ctx",
+                 "t_marks")
 
     def __init__(self, entry, Hs, Tp, beta, out_keys, escalate_f64, client,
                  cache_key, trace_ctx=None):
@@ -94,6 +95,10 @@ class _Request:
         # tick span links to it, so one trace covers client -> queue ->
         # tick -> dispatch -> response across the thread boundary
         self.trace_ctx = trace_ctx
+        # (tick_t0, dispatch_t0, dispatch_t1, solve_s) stamped by the
+        # tick that dispatched this request — the tail-attribution
+        # stage decomposition reads these at resolve time
+        self.t_marks = None
 
 
 class Batcher:
@@ -229,7 +234,7 @@ class Batcher:
                 span_kw["links"] = links
         with span("serve_tick", rows=len(batch), unique=len(unique),
                   **span_kw):
-            n_dispatch, deferred = self._dispatch_groups(groups)
+            n_dispatch, deferred = self._dispatch_groups(groups, t0)
             # escalation re-solves run LAST (and still on this thread:
             # _rung_flags mutates process-wide env, so a parallel
             # escalation would leak f64 flags into a concurrent normal
@@ -250,10 +255,13 @@ class Batcher:
             self._cond.notify_all()
         return len(batch)
 
-    def _dispatch_groups(self, groups):
+    def _dispatch_groups(self, groups, tick_t0):
         """Dispatch every signature group of one tick; returns
         ``(n_dispatch, deferred)`` where ``deferred`` is the
-        (reqs, row) list awaiting an f64 escalation re-solve."""
+        (reqs, row) list awaiting an f64 escalation re-solve.
+        ``tick_t0`` is the tick's start instant: every dispatched
+        request gets (tick_t0, dispatch window, solve wall) marks so
+        resolve time can decompose its latency into stages."""
         n_dispatch = 0
         deferred = []
         for sig, reqlists in groups.items():
@@ -261,13 +269,16 @@ class Batcher:
             for lo in range(0, len(reqlists), cap):
                 chunk = reqlists[lo:lo + cap]
                 firsts = [rl[0] for rl in chunk]
+                t_d0 = time.perf_counter()
+                timings = {}
                 try:
                     out = engine.dispatch(
                         [r.entry for r in firsts],
                         [r.Hs for r in firsts], [r.Tp for r in firsts],
                         [r.beta for r in firsts],
                         out_keys=self.out_keys, mesh=self.mesh,
-                        padded=engine.pick_padded(len(firsts), self.sizes))
+                        padded=engine.pick_padded(len(firsts), self.sizes),
+                        timings=timings)
                     n_dispatch += 1
                 except Exception as e:  # noqa: BLE001 — fan the failure out
                     log_event("serve_error", error=repr(e)[:300],
@@ -279,8 +290,13 @@ class Batcher:
                                 continue
                             req.future.set_exception(e)
                     continue
+                t_d1 = time.perf_counter()
+                solve_s = min(timings.get("solve_s") or 0.0, t_d1 - t_d0)
+                marks = (tick_t0, t_d0, t_d1, solve_s)
                 for i, rl in enumerate(chunk):
                     row = {k: out[k][i] for k in self.out_keys}
+                    for req in rl:
+                        req.t_marks = marks
                     if self._needs_escalation(rl, row):
                         deferred.append((rl, row))
                     else:
@@ -335,6 +351,28 @@ class Batcher:
             return  # requester went away (client timeout/cancel)
         wall = time.perf_counter() - req.t_submit
         metrics.histogram("serve_request_s").observe(wall)
+        if req.t_marks is not None and not cache_hit:
+            # tail attribution: split this request's end-to-end latency
+            # into named stages that sum to `wall` by construction —
+            # queue_wait (pending until its tick began), tick_wait
+            # (behind earlier groups inside the tick), dispatch
+            # (pack/device_put overhead), solve (compiled program +
+            # fetch), post (status fold / cache insert / escalation)
+            tick_t0, d0, d1, solve_s = req.t_marks
+            stages = {
+                "queue_wait": max(tick_t0 - req.t_submit, 0.0),
+                "tick_wait": max(d0 - tick_t0, 0.0),
+                "dispatch": max((d1 - d0) - solve_s, 0.0),
+                "solve": solve_s,
+            }
+            stages["post"] = max(wall - sum(stages.values()), 0.0)
+            for name, v in stages.items():
+                metrics.histogram(f"serve_stage_{name}_s").observe(v)
+            if structlog.enabled():
+                log_event("serve_request_stages", wall_s=round(wall, 6),
+                          escalated=escalated is not None,
+                          **{f"{k}_s": round(v, 6)
+                             for k, v in stages.items()})
         # the sliding-window twin of the lifetime histogram: /healthz
         # p50/p95-over-last-N-seconds and the SLO breach gate read this
         metrics.window("serve_request_window_s").observe(wall)

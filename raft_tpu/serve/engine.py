@@ -51,7 +51,7 @@ class DesignEntry:
     """One registered design: the built model resolved into its bucket
     routing key, packed batch row and cache fingerprint."""
 
-    __slots__ = ("name", "model", "sig", "packed", "fingerprint")
+    __slots__ = ("name", "model", "sig", "packed", "fingerprint", "axes")
 
     def __init__(self, name, model):
         from raft_tpu.api import pack_for_serving
@@ -59,6 +59,9 @@ class DesignEntry:
         self.name = name
         self.model = model
         self.sig, self.packed, self.fingerprint = pack_for_serving(model)
+        # per-axis (real, padded) counts for the waste-attribution
+        # metrics every serving dispatch feeds
+        self.axes = bucketing.axis_counts(model, self.sig)
 
     def __repr__(self):
         return (f"DesignEntry({self.name!r}, "
@@ -176,7 +179,7 @@ def flags_extra():
 
 
 def dispatch(entries, Hs, Tp, beta, out_keys=DEFAULT_OUT_KEYS, mesh=None,
-             padded=None, record_metrics=True):
+             padded=None, record_metrics=True, timings=None):
     """Evaluate one coalesced request group (ONE bucket signature).
 
     entries : per-row :class:`DesignEntry` (repeat an entry to evaluate
@@ -186,6 +189,11 @@ def dispatch(entries, Hs, Tp, beta, out_keys=DEFAULT_OUT_KEYS, mesh=None,
         default: the smallest rung holding the rows
     record_metrics : False for non-serving traffic (startup warmup) so
         the occupancy/dispatch metrics describe ONLY real request load
+    timings : optional dict the call fills with ``solve_s`` (the
+        batcher's tail-attribution stage split; it measures the full
+        dispatch window itself) — an out-param so concurrent dispatch
+        paths cannot misattribute each other's walls, which a
+        module-global "last timings" would
 
     Returns ``{out_key: host numpy array}`` of length ``len(entries)``
     (padding rows dropped).  The memo/bank key is IDENTICAL to
@@ -237,13 +245,23 @@ def dispatch(entries, Hs, Tp, beta, out_keys=DEFAULT_OUT_KEYS, mesh=None,
         lambda x, s: jax.device_put(np.asarray(x), s), case, in_sh)
     with span("sweep_dispatch", kind="serve", rows=n,
               bucket=bucketing.signature_fingerprint(sig)):
+        t_solve0 = time.perf_counter()
         res = fn(args)
         res = {kk: np.asarray(res[kk])[:n] for kk in out_keys}
+    # tail attribution: the batcher splits each coalesced request's
+    # latency into stage walls; solve = compiled-program execution +
+    # result fetch, the rest of the dispatch wall is pack/device_put
+    if timings is not None:
+        timings["solve_s"] = time.perf_counter() - t_solve0
     if record_metrics:
         metrics.counter("serve_dispatches").inc()
         metrics.counter("serve_rows_dispatched").inc(n)
         metrics.histogram("serve_batch_rows").observe(n)
         metrics.histogram("serve_batch_occupancy").observe(n / padded)
+        # waste attribution: the same per-axis pad accounting the
+        # bucketed sweeps feed, here weighted by served request rows
+        bucketing.observe_axis_waste([e.axes for e in entries],
+                                     rows_valid=n, rows_padded=padded)
     return res
 
 

@@ -194,8 +194,15 @@ class Server:
                  "serve_rows_dispatched", "serve_coalesced",
                  "serve_rejected_quota", "serve_rejected_queue",
                  "serve_errors", "serve_escalations")}
+        from raft_tpu.obs.report import SERVE_STAGES
+
         occ = metrics.histogram("serve_batch_occupancy").snapshot()
         lat = metrics.histogram("serve_request_s").snapshot()
+        # tail attribution: per-stage latency histograms of every
+        # dispatched request (the capture-level p50-vs-p95 stage table
+        # lives in `obs report`; this is the live operator view)
+        stages = {s: metrics.histogram(f"serve_stage_{s}_s").snapshot()
+                  for s in SERVE_STAGES}
         window_s = float(config.get("SERVE_WINDOW_S"))
         win = metrics.window("serve_request_window_s").snapshot(window_s)
         slo_ms = float(config.get("SERVE_SLO_MS") or 0)
@@ -210,6 +217,7 @@ class Server:
             # the sliding view an operator actually pages on: p50/p95
             # over the last RAFT_TPU_SERVE_WINDOW_S seconds + SLO state
             "window": win,
+            "request_stages": stages,
             "slo": {"slo_ms": slo_ms or None,
                     "breaches": metrics.counter("serve_slo_breaches").value},
             # device-cost ledger: per-program flops / dispatches /
@@ -381,6 +389,15 @@ class Server:
         path = config.get("METRICS")
         if path:
             metrics.export(path)
+        # 5. append the session's run record (RAFT_TPU_RUNS_DIR): the
+        #    metrics registry at drain carries the whole serving story
+        #    — request/stage/occupancy histograms, waste counters,
+        #    cost ledger — so the longitudinal store sees every session
+        from raft_tpu.obs import runs as obs_runs
+
+        obs_runs.maybe_record(
+            "serve", wall_s=time.perf_counter() - _T0,
+            extra={"requests": metrics.counter("serve_requests").value})
         log_event("serve_stop",
                   requests=metrics.counter("serve_requests").value,
                   wall_s=round(time.perf_counter() - t0, 3))

@@ -284,6 +284,69 @@ def padding_waste_frac(packed_list):
     return 1.0 - valid / total if total else 0.0
 
 
+def axis_counts(model, sig=None):
+    """``{axis: (real, padded)}`` per padded design axis of one model
+    in its bucket — the waste-attribution unit ROADMAP item 5a tunes
+    bucket ladders from.  The strips axis reproduces the aggregate
+    :func:`padding_waste_frac` when summed over a batch (both are
+    ``1 - sum(real)/sum(padded)``); nodes and mooring lines get the
+    same treatment so the waste table names WHICH axis the pad budget
+    goes to, not just that 35% of strip rows are masked."""
+    sig = sig or bucket_signature(model)
+    meta = signature_meta(sig)
+    fs = model.fowtList[0]
+    ms = model.ms
+    return {
+        "strips": (int(model.hydro[0].strips.S), int(meta["S"])),
+        "nodes": (int(fs.n_nodes), int(meta["N"])),
+        "lines": (0 if ms is None else int(ms.n_lines), int(meta["L"])),
+    }
+
+
+def waste_by_axis(axis_counts_list):
+    """Row-weighted per-axis padding waste over a batch (one
+    ``axis_counts`` dict per dispatched row): ``{axis: {valid, padded,
+    waste_frac}}`` with ``waste_frac = 1 - sum(valid)/sum(padded)`` —
+    the exact row-weighted aggregate, not a mean of per-row fractions
+    (990 floor-bucket rows + 10 big-semi rows must not report the
+    unweighted 2-design mean)."""
+    agg: dict = {}
+    for axes in axis_counts_list:
+        for name, (real, padded) in axes.items():
+            v, t = agg.get(name, (0, 0))
+            agg[name] = (v + int(real), t + int(padded))
+    return {name: {"valid": v, "padded": t,
+                   "waste_frac": round(1.0 - v / t, 6) if t else 0.0}
+            for name, (v, t) in agg.items()}
+
+
+def observe_axis_waste(axis_counts_list, rows_valid=None, rows_padded=None):
+    """Feed the per-axis waste metrics for one dispatched batch: exact
+    ``pad_valid_<axis>``/``pad_total_<axis>`` counter pairs (their
+    ratio IS the row-weighted aggregate, summable across dispatches
+    and processes) plus a ``pad_waste_<axis>`` histogram of each row's
+    own pad fraction (the distribution view: a bimodal histogram says
+    "split the bucket", a uniform one says "shrink the floor").  The
+    optional ``rows_valid``/``rows_padded`` pair records the BATCH-row
+    axis (masked repeat rows added for dp-divisibility / ladder
+    padding) the same way."""
+    from raft_tpu.obs import metrics
+
+    for axes in axis_counts_list:
+        for name, (real, padded) in axes.items():
+            if not padded:
+                continue
+            metrics.counter(f"pad_valid_{name}").inc(int(real))
+            metrics.counter(f"pad_total_{name}").inc(int(padded))
+            metrics.histogram(f"pad_waste_{name}").observe(
+                1.0 - real / padded)
+    if rows_padded:
+        metrics.counter("pad_valid_rows").inc(int(rows_valid))
+        metrics.counter("pad_total_rows").inc(int(rows_padded))
+        metrics.histogram("pad_waste_rows").observe(
+            1.0 - rows_valid / rows_padded)
+
+
 # ------------------------------------------------------------- evaluator
 
 @dataclass

@@ -255,6 +255,30 @@ _register(
          help="when set, the metrics registry is exported in Prometheus "
               "text format to this path at sweep_done (scrape target "
               "for long runs)"),
+    # -- longitudinal run-record store (see raft_tpu.obs.runs and
+    #    README "Performance regression tracking")
+    Flag("RUNS_DIR", "str", "",
+         help="append-only run-record store directory: when set, every "
+              "bench / checkpointed-sweep / serve session ends by "
+              "writing a schema-versioned run record (env fingerprint, "
+              "metrics snapshot, cost ledger, compile counts) there; "
+              "`python -m raft_tpu.obs runs {list,compare,regress}` "
+              "read it.  Unset (default) disables recording"),
+    Flag("RUNS_REL_TOL", "float", 0.5,
+         help="when SET, overrides every watch rule's relative "
+              "worsening tolerance in `obs runs regress` (the "
+              "noisier-host loosening knob; a watched metric "
+              "regresses only past max(rel_tol x |baseline|, abs "
+              "floor)).  Unset, the per-rule tolerances apply: "
+              "latency-histogram rules use 1.0 (their percentiles "
+              "move in log-bucket quantization steps of ~1.78x), "
+              "throughput rules 0.5.  `--rel-tol` outranks both"),
+    Flag("RUNS_ABS_FLOOR", "float", 1.0,
+         help="global multiplier on the per-rule minimum-absolute-"
+              "delta floors of `obs runs regress` (raise it to mute "
+              "sub-floor jitter on noisier hosts; the floors keep "
+              "tiny-relative-but-huge-percentage changes on "
+              "near-zero baselines from failing CI)"),
     Flag("FAULTS", "raw", "",
          help="deterministic fault injection: comma list of "
               "kind:site[:count] specs (see raft_tpu.utils.faults)"),

@@ -28,6 +28,11 @@ truncate   shard_write        shard file is truncated after the atomic
                               filesystem that lost the tail)
 nan        shard_result       first row of the computed shard is poisoned
                               with NaN
+delay      shard_eval         sleep 0.25 s before the shard evaluation (a
+                              deliberately slowed dispatch — the injected
+                              perf regression `python -m raft_tpu.obs
+                              runs regress` must catch; arm with a count
+                              covering every shard)
 unhealthy  backend_probe      ``probe_backend()`` reports the backend dead
 worker_kill worker_shard      fabric worker SIGKILLs itself right after
                               claiming a shard lease (simulates a

@@ -257,6 +257,13 @@ def main():
     )
     with open("SWEEP_10K.json", "w") as f:
         json.dump(summary, f, indent=1)
+    # run-record twin of the artifact (RAFT_TPU_RUNS_DIR): the summary
+    # scalars (design_evals_per_s above all) join the store so `obs
+    # runs regress` can gate the north-star throughput trajectory
+    from raft_tpu.obs import runs as obs_runs
+
+    obs_runs.maybe_record("sweep_10k", label=args.out, wall_s=wall,
+                          extra=summary)
     print(json.dumps(summary))
 
 

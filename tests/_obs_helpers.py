@@ -1,0 +1,29 @@
+"""Shared helpers for tests that read structured-log captures.
+
+Not a pytest module (underscore name).  Since PR 10 every structlog
+sink opens with a ``proc_start`` clock-anchor record, so every test
+that used to assert on ``lines[0]`` (or count events) needs the anchor
+skipped — this helper centralizes that instead of each test hand-
+rolling its own ``proc_start`` filtering.
+"""
+
+from __future__ import annotations
+
+
+def read_events(path, skip_anchor=True, name=None):
+    """Parsed events of one JSONL capture, asserting zero damaged
+    lines.
+
+    skip_anchor : drop the ``proc_start`` clock-anchor record(s) each
+        sink opens with (pass ``False`` to assert on them).
+    name : keep only events with this name.
+    """
+    from raft_tpu.obs import report
+
+    events, bad = report.read_events(str(path))
+    assert bad == 0, f"{bad} unparseable lines in {path}"
+    if skip_anchor:
+        events = [e for e in events if e["event"] != "proc_start"]
+    if name is not None:
+        events = [e for e in events if e["event"] == name]
+    return events

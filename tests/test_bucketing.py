@@ -109,6 +109,29 @@ def test_padding_waste_frac(trio):
     assert bucketing.padding_waste_frac([]) == 0.0
 
 
+def test_axis_counts_reproduce_aggregate_waste(trio):
+    """Per-axis waste attribution: the strips axis of waste_by_axis is
+    the SAME row-weighted aggregate padding_waste_frac reports (both
+    are 1 - sum(real)/sum(padded)), and nodes/lines decompose the rest
+    of the pad budget."""
+    models, sigs = trio
+    axes = [bucketing.axis_counts(m, s) for m, s in zip(models, sigs)]
+    for m, s, a in zip(models, sigs, axes):
+        meta = bucketing.signature_meta(s)
+        assert a["strips"] == (m.hydro[0].strips.S, meta["S"])
+        assert a["nodes"] == (m.fowtList[0].n_nodes, meta["N"])
+        assert a["lines"][1] == meta["L"]
+    by_axis = bucketing.waste_by_axis(axes)
+    packed = [bucketing.pack_design(m, s) for m, s in zip(models, sigs)]
+    # waste_frac is rounded to 6 decimals for the event payload
+    assert by_axis["strips"]["waste_frac"] == pytest.approx(
+        bucketing.padding_waste_frac(packed), abs=1e-6)
+    for axis in ("strips", "nodes", "lines"):
+        rec = by_axis[axis]
+        assert 0.0 <= rec["waste_frac"] < 1.0
+        assert rec["valid"] <= rec["padded"]
+
+
 def test_unbucketable_gates(trio):
     models, _ = trio
     spar = models[0]
@@ -153,10 +176,30 @@ def test_mixed_sweep_parity_and_compile_budget(trio):
     mesh = make_mesh(8)
     keys = ("PSD", "X0", "Xi", "status")
 
+    from raft_tpu.obs import metrics as obs_metrics
+
+    pad0 = {k: obs_metrics.counter(k).value
+            for k in ("pad_valid_strips", "pad_total_strips",
+                      "pad_valid_rows", "pad_total_rows")}
     with count_compilations() as clog:
         out = sweep_heterogeneous(rows, Hs, Tp, beta, mesh=mesh,
                                   out_keys=keys)
     assert clog.real_count <= n_buckets
+    # waste attribution: the per-axis counters reproduce the aggregate
+    # row-weighted strips waste exactly, and the batch-rows axis
+    # records the dp autopadding (5 rows padded onto the dp=8 mesh)
+    dv = obs_metrics.counter("pad_valid_strips").value \
+        - pad0["pad_valid_strips"]
+    dt = obs_metrics.counter("pad_total_strips").value \
+        - pad0["pad_total_strips"]
+    agg = bucketing.padding_waste_frac(
+        [bucketing.pack_design(m) for m in rows])
+    assert dt > 0 and 1.0 - dv / dt == pytest.approx(agg, abs=1e-9)
+    assert obs_metrics.counter("pad_valid_rows").value \
+        - pad0["pad_valid_rows"] == n
+    # 2 bucket groups (4 + 1 rows), each dp-autopadded to the dp=8 mesh
+    assert obs_metrics.counter("pad_total_rows").value \
+        - pad0["pad_total_rows"] == 16
 
     with count_compilations() as clog2:
         out2 = sweep_heterogeneous(rows, Hs, Tp, beta, mesh=mesh,

@@ -32,6 +32,8 @@ from raft_tpu.obs.heartbeat import Heartbeat
 from raft_tpu.parallel.sweep import make_mesh, run_sweep_checkpointed_full
 from raft_tpu.utils import faults, structlog
 
+from _obs_helpers import read_events as _helper_read_events
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -45,10 +47,11 @@ def _cases(n, seed=0):
     return dict(Hs=2.0 + 6.0 * rng.random(n), Tp=8.0 + 8.0 * rng.random(n))
 
 
-def _events(path, name=None):
-    evs, bad = obs_report.read_events(path)
-    assert bad == 0
-    return [e for e in evs if name is None or e["event"] == name]
+def _events(path, name=None, skip_anchor=True):
+    # the shared anchor-aware capture reader (tests/_obs_helpers.py):
+    # the proc_start clock anchor every sink opens with is skipped by
+    # default; this module's historical call order is (path, name)
+    return _helper_read_events(path, skip_anchor=skip_anchor, name=name)
 
 
 MESH = None
@@ -199,7 +202,7 @@ def test_log_directory_shards_per_process(tmp_path, monkeypatch):
     structlog.log_event("shard_start", shard=0, rows=4)
     shard_file = d / f"trace-{os.getpid()}.jsonl"
     assert shard_file.exists()
-    evs = _events(str(shard_file))
+    evs = _events(str(shard_file), skip_anchor=False)
     # the shard opens with the proc_start clock anchor
     assert evs[0]["event"] == "proc_start"
     assert evs[0]["unix_t"] > 1e9 and "argv0" in evs[0]
@@ -702,6 +705,87 @@ def test_heartbeat_disabled_by_default(monkeypatch):
 
     with maybe_heartbeat() as hb:
         assert hb is None
+
+
+def test_heartbeat_samples_host_rss(log_path):
+    """Each beat carries the host process RSS/high-watermark from
+    /proc/self/status (no psutil), and the gauges' watermarks survive
+    into the metrics snapshot for run records."""
+    from raft_tpu.obs.heartbeat import sample_host_rss
+
+    rss, hwm = sample_host_rss()
+    if rss is None:
+        pytest.skip("no /proc/self/status on this platform")
+    assert rss > 1024 ** 2          # a live jax process holds > 1 MiB
+    assert hwm is None or hwm >= rss
+    hb = Heartbeat(0.02)
+    hb.beat()
+    (ev,) = _events(log_path, "heartbeat")
+    assert ev["host_rss_bytes"] > 1024 ** 2
+    assert metrics.gauge("host_rss_bytes").max >= ev["host_rss_bytes"]
+    snap = metrics.snapshot()
+    assert snap["gauges"]["host_rss_bytes"]["max"] > 0
+
+
+def test_report_serve_stage_and_waste_tables():
+    """The tail-attribution table's p50/p95 columns are the stage
+    breakdown of the request at that latency rank (stages sum to THAT
+    request's measured total), and the waste table reproduces the
+    row-weighted per-axis aggregate from the exact counter pairs."""
+    def req(wall, solve):
+        rest = wall - solve
+        return {"t": 0.1, "event": "serve_request_stages", "pid": 1,
+                "run_id": "r", "wall_s": wall, "queue_wait_s": rest * 0.5,
+                "tick_wait_s": rest * 0.2, "dispatch_s": rest * 0.2,
+                "solve_s": solve, "post_s": rest * 0.1, "escalated": False}
+
+    events = [req(0.010, 0.006)] * 10 + [req(0.200, 0.012)]
+    snap = {"counters": {"pad_valid_strips": 141, "pad_total_strips": 192,
+                         "pad_valid_rows": 3, "pad_total_rows": 4},
+            "histograms": {"pad_waste_strips":
+                           {"count": 3, "mean": 0.2656, "p50": 0.25,
+                            "p95": 0.3, "min": 0.2, "max": 0.3,
+                            "sum": 0.8}}}
+    events.append({"t": 0.5, "event": "metrics_snapshot", "pid": 1,
+                   "run_id": "r", "snapshot": snap})
+    data = obs_report.report_data(events)
+    att = data["serve_stages"]
+    assert att["n_requests"] == 11
+    # stages sum EXACTLY to the ranked request's measured total
+    assert att["p50"]["stages_sum_s"] == pytest.approx(
+        att["p50"]["total_s"], rel=1e-6)
+    assert att["p95"]["stages_sum_s"] == pytest.approx(
+        att["p95"]["total_s"], rel=1e-6)
+    # the tail request IS the p95 column: its solve+queue dominate
+    assert att["p95"]["total_s"] == pytest.approx(0.200)
+    assert att["p50"]["total_s"] == pytest.approx(0.010)
+    waste = data["waste"]["axes"]
+    assert waste["strips"] == {
+        "valid": 141, "padded": 192,
+        "waste_frac": pytest.approx(1 - 141 / 192),
+        "rows": 3, "row_mean": 0.2656, "row_p95": 0.3}
+    assert waste["rows"]["waste_frac"] == pytest.approx(0.25)
+    txt = obs_report.render_report(events)
+    assert "serve tail attribution" in txt
+    assert "padding waste by axis" in txt
+    # json CLI twin renders the same sections
+    assert data["snapshot"]["counters"]["pad_total_strips"] == 192
+
+
+def test_waste_attribution_falls_back_to_bucket_sweep_events():
+    events = [
+        {"t": 0.1, "event": "bucket_sweep", "pid": 1, "run_id": "r",
+         "rows": 3, "n_buckets": 2, "n_designs": 3,
+         "padding_waste_frac": 0.2656,
+         "waste_by_axis": {"strips": {"valid": 141, "padded": 192,
+                                      "waste_frac": 0.265625},
+                           "rows": {"valid": 3, "padded": 4,
+                                    "waste_frac": 0.25}}},
+    ]
+    waste = obs_report.waste_attribution(events, snapshot={})
+    assert waste["axes"]["strips"]["waste_frac"] == pytest.approx(
+        1 - 141 / 192)
+    assert obs_report.waste_attribution([], snapshot={}) is None
 
 
 # -------------------------------------------------------------- structlog

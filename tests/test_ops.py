@@ -294,37 +294,38 @@ def test_structlog_events(tmp_path, monkeypatch):
     are emitted as one JSON object per line when RAFT_TPU_LOG is set,
     the sink follows mid-process env-var changes (no import-time
     latching), and the module is a strict no-op otherwise."""
-    import json
-
     import raft_tpu.utils.structlog as sl
+    from _obs_helpers import read_events
 
     dest = tmp_path / "log.jsonl"
     monkeypatch.setenv("RAFT_TPU_LOG", str(dest))
     with sl.stage("unit_stage", case=3):
         pass
     sl.log_event("custom", resid=1.5e-3, converged=True)
-    lines = [json.loads(x) for x in dest.read_text().splitlines()]
     # every sink opens with the proc_start clock anchor (PR 10: the
     # `obs trace --merge` cross-process timeline needs unix_t <-> t)
-    assert lines[0]["event"] == "proc_start" and lines[0]["unix_t"] > 1e9
-    assert lines[1]["event"] == "unit_stage"
-    assert lines[1]["ok"] is True and lines[1]["case"] == 3
-    assert lines[1]["wall_s"] >= 0
+    (anchor,) = read_events(dest, skip_anchor=False, name="proc_start")
+    assert anchor["unix_t"] > 1e9
+    stage_ev, custom = read_events(dest)  # anchor skipped by default
+    assert stage_ev["event"] == "unit_stage"
+    assert stage_ev["ok"] is True and stage_ev["case"] == 3
+    assert stage_ev["wall_s"] >= 0
     # every record carries the pid/run_id telemetry stamps (PR 5)
     import os as _os
 
-    assert lines[2] == {"t": lines[2]["t"], "event": "custom",
-                        "pid": _os.getpid(), "run_id": lines[2]["run_id"],
-                        "resid": 1.5e-3, "converged": True}
-    assert lines[1]["run_id"] == lines[2]["run_id"]
+    assert custom == {"t": custom["t"], "event": "custom",
+                      "pid": _os.getpid(), "run_id": custom["run_id"],
+                      "resid": 1.5e-3, "converged": True}
+    assert stage_ev["run_id"] == custom["run_id"]
 
     # retargeting mid-process takes effect without a module reload
     # (the fresh sink gets its own anchor)
     dest2 = tmp_path / "log2.jsonl"
     monkeypatch.setenv("RAFT_TPU_LOG", str(dest2))
     sl.log_event("retargeted")
-    anchor, ev = [json.loads(x) for x in dest2.read_text().splitlines()]
-    assert anchor["event"] == "proc_start"
+    assert read_events(dest2, skip_anchor=False,
+                       name="proc_start")  # fresh sink, fresh anchor
+    (ev,) = read_events(dest2)
     assert ev["event"] == "retargeted"
 
     monkeypatch.delenv("RAFT_TPU_LOG")

@@ -1,0 +1,287 @@
+"""Run-record store + perf-regression sentinel tests
+(:mod:`raft_tpu.obs.runs`).
+
+Fast tier, toy evaluators on a small CPU mesh:
+
+* a checkpointed sweep with ``RAFT_TPU_RUNS_DIR`` set appends one
+  schema-versioned record (env fingerprint, metrics snapshot, git SHA);
+* the acceptance drill: clean back-to-back runs regress-clean (exit 0),
+  a faults-injected delayed dispatch is caught (exit 1) with the
+  regressed metric named;
+* env-fingerprint mismatch downgrades failures to warnings;
+* baseline pinning + newest-record resolution in the CLI;
+* ``runs ingest`` imports the historical BENCH artifacts (including the
+  early driver-wrapper schema and the timed-out r03 round);
+* ``obs report --format json`` / ``runs record --events`` speak the
+  same machine-readable section schema.
+"""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.obs import metrics, runs
+from raft_tpu.parallel.sweep import make_mesh, run_sweep_checkpointed_full
+from raft_tpu.utils import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "runs")
+
+
+def toy_full(c):
+    return {"PSD": jnp.stack([c["Hs"], c["Tp"], c["Hs"] * c["Tp"]]),
+            "X0": c["Hs"] - c["Tp"]}
+
+
+def _cases(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return dict(Hs=2.0 + 6.0 * rng.random(n), Tp=8.0 + 8.0 * rng.random(n))
+
+
+MESH = None
+
+
+def mesh2():
+    global MESH
+    if MESH is None:
+        MESH = make_mesh(2)
+    return MESH
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+@pytest.fixture
+def store(tmp_path, monkeypatch):
+    d = str(tmp_path / "runs")
+    os.makedirs(d)
+    monkeypatch.setenv("RAFT_TPU_RUNS_DIR", d)
+    return d
+
+
+def _sweep(tmp_path, name, n=8, seed=0):
+    out = run_sweep_checkpointed_full(
+        toy_full, _cases(n, seed), str(tmp_path / name), shard_size=4,
+        mesh=mesh2())
+    return out
+
+
+def _cli(*args, env=None):
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    return subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs", "runs", *args],
+        capture_output=True, text=True, cwd=REPO, env=e)
+
+
+# ------------------------------------------------------------- the store
+
+
+def test_sweep_appends_schema_versioned_record(store, tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_RUN_ID", "runstore01")
+    _sweep(tmp_path, "s1")
+    records = runs.list_records(store)
+    assert len(records) == 1
+    path, rec = records[0]
+    assert rec["schema"] == runs.SCHEMA_VERSION
+    assert rec["kind"] == "sweep" and rec["label"] == "s1"
+    assert rec["run_id"] == "runstore01"
+    assert rec["wall_s"] > 0
+    # env fingerprint: host + toolchain + backend, all comparison keys
+    env = rec["env"]
+    for k in runs.ENV_COMPARE_KEYS:
+        assert env.get(k) is not None, k
+    assert env["platform"] == "cpu" and env["n_devices"] == 8
+    assert re.fullmatch(r"[0-9a-f]{16}", env["code"])
+    # git SHA of this checkout rides along
+    assert rec["git_sha"] is None or re.fullmatch(r"[0-9a-f]{40}",
+                                                  rec["git_sha"])
+    flat = runs.flatten(rec)
+    assert flat["counter:shards_done"] == 2
+    assert flat["counter:rows_evaluated"] == 8
+    assert flat["hist:shard_wall_s:p95"] > 0
+    assert flat["extra:n_cases"] == 8
+    # unset store = disabled recording, not an error
+    monkeypatch.delenv("RAFT_TPU_RUNS_DIR")
+    assert runs.maybe_record("sweep") is None
+
+
+def test_regress_clean_then_catches_injected_slowdown(store, tmp_path):
+    """The acceptance drill: same-host clean back-to-back runs pass
+    (exit 0, noise thresholds hold); a deliberately slowed dispatch
+    (delay fault at shard_eval) is caught with exit 1 and the regressed
+    metric named."""
+    _sweep(tmp_path, "base", seed=1)
+    metrics.reset()
+    _sweep(tmp_path, "clean", seed=1)
+    metrics.reset()
+    with faults.inject("delay:shard_eval:8"):
+        _sweep(tmp_path, "slow", seed=1)
+    records = runs.list_records(store)
+    assert [r["label"] for _, r in records] == ["base", "clean", "slow"]
+    (p_base, base), (p_clean, clean), (p_slow, slow) = records
+
+    verdict = runs.regress_records(clean, base)
+    assert verdict["comparable"] and verdict["ok"]
+    assert verdict["checked"] > 0 and not verdict["regressions"]
+
+    verdict = runs.regress_records(slow, base)
+    assert not verdict["ok"]
+    regressed = {r["metric"] for r in verdict["regressions"]}
+    assert "hist:shard_wall_s:p95" in regressed
+
+    # the CLI contract: exit 0 clean, exit 1 naming the metric
+    p = _cli("regress", p_clean, "--baseline", p_base, "--check")
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "no regressions" in p.stdout
+    p = _cli("regress", p_slow, "--baseline", p_base, "--check")
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "hist:shard_wall_s:p95" in p.stdout
+    assert "REGRESSION" in p.stdout
+
+
+def test_env_mismatch_downgrades_to_warning(store, tmp_path):
+    _sweep(tmp_path, "a", seed=2)
+    metrics.reset()
+    with faults.inject("delay:shard_eval:8"):
+        _sweep(tmp_path, "b", seed=2)
+    (p_a, a), (p_b, b) = runs.list_records(store)
+    assert not runs.regress_records(b, a)["ok"]  # same env: caught
+    # different host fingerprint: the SAME slowdown only warns
+    b2 = json.loads(json.dumps(b))
+    b2["env"]["host"] = "some-other-box"
+    verdict = runs.regress_records(b2, a)
+    assert verdict["env_mismatch"] == ["host"]
+    assert verdict["regressions"] and verdict["ok"]
+
+
+def test_pin_and_newest_resolution(store):
+    for day, name in enumerate(("baseline", "clean", "regressed"), 1):
+        shutil.copy(
+            os.path.join(FIXTURES, f"{name}.json"),
+            os.path.join(store,
+                         f"run-2025010{day}T000000-1-{name[:6]}.json"))
+    records = runs.list_records(store)
+    assert len(records) == 3
+    runs.pin_baseline(records[0][0], store)
+    assert os.path.samefile(runs.pinned_baseline(store), records[0][0])
+    # default resolution: newest record (the regressed fixture) vs pin
+    p = _cli("regress", "--dir", store)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "hist:shard_wall_s:p95" in p.stdout
+    # list marks the pinned baseline
+    p = _cli("list", "--dir", store)
+    assert p.returncode == 0 and "baseline:" in p.stdout
+
+
+def test_ingest_bench_artifacts(store):
+    # modern artifact (r07: serve bench), early wrapper (r01), and the
+    # timed-out round (r03: rc 124, parsed null) — all seven real
+    # BENCH_rNN.json shapes are covered by these three
+    r07 = runs.ingest_bench(os.path.join(REPO, "BENCH_r07.json"))
+    assert r07["label"] == "r07" and r07["env"]["ingested"]
+    assert r07["extra"]["evals_per_s"] == pytest.approx(679.98)
+    assert r07["extra"]["breakdown.serve.load.p95_ms"] == pytest.approx(690.2)
+    r01 = runs.ingest_bench(os.path.join(REPO, "BENCH_r01.json"))
+    assert r01["label"] == "r01"
+    assert r01["extra"]["value"] == pytest.approx(1351.8)
+    r03 = runs.ingest_bench(os.path.join(REPO, "BENCH_r03.json"))
+    assert r03["label"] == "r03" and r03["headline"]["failed"]
+    # ingested records only ever WARN under regress (no env fingerprint)
+    verdict = runs.regress_records(r07, r01)
+    assert verdict["env_mismatch"] == ["ingested"] and verdict["ok"]
+    # the CLI imports every artifact in one call
+    p = _cli("ingest", *(os.path.join(REPO, f"BENCH_r0{i}.json")
+                         for i in range(1, 8)), "--dir", store)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert len(runs.list_records(store)) == 7
+    labels = {r["label"] for _, r in runs.list_records(store)}
+    assert labels == {f"r0{i}" for i in range(1, 8)}
+
+
+def test_watch_rules_directions():
+    # latency percentiles: rel_tol 1.0 absorbs the log-bucket
+    # quantization step (~1.78x) a clean rerun can take
+    assert runs.watch_rule("hist:shard_wall_s:p95") == ("lower", 1.0, 0.05)
+    assert runs.watch_rule("hist:serve_stage_solve_s:p50")[0] == "lower"
+    # achieved rates end in _s but are higher-is-better — rule order
+    # (and tighter rel_tol: 1.0 could never gate a rate)
+    assert runs.watch_rule("hist:program_gflops_s:p50")[:2] == \
+        ("higher", 0.5)
+    assert runs.watch_rule("extra:design_evals_per_s")[0] == "higher"
+    assert runs.watch_rule("waste:strips")[0] == "lower"
+    assert runs.watch_rule("counter:rows_quarantined")[0] == "lower"
+    # workload-shaped metrics are informational, never gated
+    assert runs.watch_rule("counter:rows_evaluated") is None
+    assert runs.watch_rule("hist:drag_iterations:p95") is None
+    # one quantization bucket up passes, two fail (the real-model
+    # clean-rerun noise model)
+    base = {"schema": 1, "kind": "t", "env": {}, "snapshot": {
+        "counters": {}, "gauges": {},
+        "histograms": {"shard_wall_s": {
+            "count": 4, "mean": 0.032, "min": 0.03, "max": 0.04,
+            "sum": 0.13, "p50": 0.031623, "p95": 0.031623}}}}
+    import copy as _copy
+
+    bump1 = _copy.deepcopy(base)
+    bump1["snapshot"]["histograms"]["shard_wall_s"].update(
+        p50=0.056234, p95=0.056234, mean=0.056)
+    bump2 = _copy.deepcopy(base)
+    bump2["snapshot"]["histograms"]["shard_wall_s"].update(
+        p50=0.1, p95=0.1, mean=0.1)
+    assert runs.regress_records(bump1, base)["ok"]
+    assert not runs.regress_records(bump2, base)["ok"]
+
+
+def test_report_json_and_record_events_cli(store, tmp_path):
+    """`obs report --format json` exposes every section machine-
+    readably, and `runs record --events` embeds exactly those sections
+    in the record instead of re-parsing rendered text."""
+    cap = tmp_path / "cap.jsonl"
+    events = [
+        {"t": 0.0, "event": "proc_start", "pid": 1, "run_id": "r",
+         "unix_t": 1700000000.0},
+        {"t": 0.1, "event": "serve_request_stages", "pid": 1, "run_id": "r",
+         "wall_s": 0.02, "queue_wait_s": 0.005, "tick_wait_s": 0.001,
+         "dispatch_s": 0.002, "solve_s": 0.011, "post_s": 0.001,
+         "escalated": False},
+        {"t": 0.2, "event": "metrics_snapshot", "pid": 1, "run_id": "r",
+         "snapshot": {"counters": {"pad_valid_strips": 9,
+                                   "pad_total_strips": 16}}},
+    ]
+    cap.write_text("".join(json.dumps(e) + "\n" for e in events))
+    p = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs", "report", str(cap),
+         "--format", "json"], capture_output=True, text=True, cwd=REPO)
+    assert p.returncode == 0, p.stdout + p.stderr
+    data = json.loads(p.stdout)
+    assert data["serve_stages"]["n_requests"] == 1
+    assert data["serve_stages"]["p95"]["stages_sum_s"] == pytest.approx(
+        0.02)
+    assert data["waste"]["axes"]["strips"]["waste_frac"] == pytest.approx(
+        1 - 9 / 16)
+    assert data["meta"]["events"] == 3 and data["event_counts"]
+
+    p = _cli("record", "--kind", "capture", "--label", "t",
+             "--events", str(cap), "--dir", store,
+             "--extra-json", '{"evals_per_s": 123.0}')
+    assert p.returncode == 0, p.stdout + p.stderr
+    ((path, rec),) = runs.list_records(store)
+    assert rec["report"]["serve_stages"]["n_requests"] == 1
+    flat = runs.flatten(rec)
+    assert flat["stage:solve:p95"] == pytest.approx(0.011)
+    assert flat["waste:strips"] == pytest.approx(1 - 9 / 16)
+    assert flat["extra:evals_per_s"] == 123.0

@@ -176,6 +176,59 @@ def test_tick_span_links_request_spans(serve_stack, tmp_path, monkeypatch):
     assert dispatch["trace_id"] == tick["trace_id"]
 
 
+def test_request_stage_attribution_sums_to_total(serve_stack, tmp_path,
+                                                 monkeypatch):
+    """Tail attribution: every dispatched request's latency decomposes
+    into queue-wait / tick-wait / dispatch / solve / post stages that
+    sum to its measured end-to-end latency, feed the serve_stage_*
+    histograms, and render as the p50-vs-p95 table in obs report."""
+    from raft_tpu.obs import metrics
+    from raft_tpu.obs import report as obs_report
+    from raft_tpu.obs.report import SERVE_STAGES
+
+    _, batcher = serve_stack
+    log = str(tmp_path / "stage_events.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", log)
+    c0 = {s: metrics.histogram(f"serve_stage_{s}_s").count
+          for s in SERVE_STAGES}
+    # 2 unique rows -> one dispatch through the module's already-warm
+    # 2-row program (a 3rd unique would compile a 1-row program)
+    futs = [batcher.submit("spar", 4.5 + 0.125 * i, 9.5, 0.02 * i)
+            for i in range(2)]
+    batcher.run_tick()
+    for f in futs:
+        f.result(timeout=60)
+    for s in SERVE_STAGES:
+        assert metrics.histogram(f"serve_stage_{s}_s").count - c0[s] == 2
+    evs, bad = obs_report.read_events(log)
+    assert bad == 0
+    stage_evs = [e for e in evs if e["event"] == "serve_request_stages"]
+    assert len(stage_evs) == 2
+    for e in stage_evs:
+        total = sum(e[f"{s}_s"] for s in SERVE_STAGES)
+        # stages sum to the measured end-to-end latency (well inside
+        # the 10% acceptance bound — equality up to rounding)
+        assert total == pytest.approx(e["wall_s"], rel=0.01, abs=1e-4)
+        assert e["solve_s"] > 0
+    att = obs_report.report_data(evs)["serve_stages"]
+    assert att["n_requests"] == 2
+    for col in ("p50", "p95"):
+        assert att[col]["stages_sum_s"] == pytest.approx(
+            att[col]["total_s"], rel=0.01, abs=1e-4)
+    # waste attribution fed by the serving dispatch: exact per-axis
+    # counter pairs (strips are genuinely padded for the spar; the
+    # rows axis only pads when a tick is short of its ladder rung)
+    assert metrics.counter("pad_total_strips").value \
+        > metrics.counter("pad_valid_strips").value > 0
+    assert metrics.counter("pad_total_rows").value \
+        >= metrics.counter("pad_valid_rows").value > 0
+    # cache hits resolve at submit and carry no stage decomposition
+    n0 = metrics.histogram("serve_stage_solve_s").count
+    fut = batcher.submit("spar", 4.5, 9.5, 0.0)
+    assert fut.result(timeout=5)["cache_hit"]
+    assert metrics.histogram("serve_stage_solve_s").count == n0
+
+
 def test_slo_breach_window_and_healthz(serve_stack, monkeypatch):
     from raft_tpu.obs import metrics
     from raft_tpu.serve.http import Server
