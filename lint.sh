@@ -20,6 +20,38 @@ fi
 
 python -m raft_tpu.analysis lint
 
+# concurrency invariants over the shared-state + serve modules:
+# atomic-write / async-blocking / lock-discipline / thread-hygiene —
+# the checked-in tree is CLEAN, and each seeded bad fixture must be
+# caught with EXACTLY exit 1 (a crash/usage error is a broken engine,
+# not a caught finding)
+python -m raft_tpu.analysis concurrency
+for fixture in bad_atomic bad_async bad_lock bad_thread; do
+    conc_rc=0
+    python -m raft_tpu.analysis concurrency \
+        "tests/fixtures/lint/$fixture.py" > /dev/null 2>&1 || conc_rc=$?
+    if [ "$conc_rc" -ne 1 ]; then
+        echo "lint.sh: analysis concurrency exited $conc_rc on the" \
+             "$fixture fixture (want 1: findings reported)" >&2
+        exit 1
+    fi
+done
+
+# cross-process schema contracts: writer/reader key sets of every
+# record family (lease, done-record, worker status, fabric.json,
+# manifest/fingerprint, quarantine v2, run-record v1, AOT sidecar)
+# must match the checked-in analysis/schema_baseline.json with no
+# reader-never-written / required-but-conditional drift; the seeded
+# drifted-lease fixture must be caught with EXACTLY exit 1
+python -m raft_tpu.analysis schemas
+schema_rc=0
+python -m raft_tpu.analysis schemas --fixture > /dev/null 2>&1 || schema_rc=$?
+if [ "$schema_rc" -ne 1 ]; then
+    echo "lint.sh: analysis schemas --fixture exited $schema_rc on the" \
+         "drifted-lease fixture (want 1: drift caught)" >&2
+    exit 1
+fi
+
 # jaxpr contracts over the health-instrumented entry points
 # (solve_dynamics_fowt, the design evaluator, the status fold): the
 # status word must stay gather-free/callback-free and inside the

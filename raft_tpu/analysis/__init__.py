@@ -19,8 +19,19 @@ instead of re-discovered as silent 10x slowdowns on a pod.
 * :mod:`raft_tpu.analysis.recompile` — a recompilation sentinel that
   counts XLA backend compiles across repeated driver/sweep
   invocations (second identical run must trigger zero).
+* :mod:`raft_tpu.analysis.concurrency` — concurrency invariants of
+  the multi-process runtime (PRs 8-11): atomic ledger/store writes,
+  a non-blocking serve event loop (taint-based), lock discipline over
+  the annotated shared registries, and thread hygiene
+  (daemon/name/stop-join) for every background sampler.
+* :mod:`raft_tpu.analysis.schemas` — cross-process writer/reader
+  schema contracts: the key sets of every record family (leases, done
+  records, worker status, fabric/manifest/quarantine JSON, run
+  records, AOT sidecars) extracted statically from their write/read
+  sites and pinned against ``analysis/schema_baseline.json``.
 
-CLI: ``python -m raft_tpu.analysis {lint,contracts,baseline,flags}``.
+CLI: ``python -m raft_tpu.analysis
+{lint,concurrency,schemas,contracts,baseline,flags}``.
 """
 
 from raft_tpu.analysis.lint import Finding, lint_paths  # noqa: F401

@@ -1,14 +1,17 @@
-"""CLI for the trace-hygiene suite.
+"""CLI for the trace-hygiene + concurrency-invariant suite.
 
     python -m raft_tpu.analysis lint [paths...]
+    python -m raft_tpu.analysis concurrency [paths...]
+    python -m raft_tpu.analysis schemas [--write | --fixture]
     python -m raft_tpu.analysis contracts [--design YAML] [--modes ...]
     python -m raft_tpu.analysis baseline --write [--design YAML]
     python -m raft_tpu.analysis flags
 
-Exit codes: 0 clean, 1 findings/violations, 2 usage error.  ``lint``
-and ``flags`` are jax-free; ``contracts``/``baseline`` trace the entry
-points and pin the CPU backend first (accelerator plugins in this
-image can hang backend init — the lint gate must never).
+Exit codes: 0 clean, 1 findings/violations, 2 usage error.  ``lint``,
+``concurrency``, ``schemas`` and ``flags`` are jax-free;
+``contracts``/``baseline`` trace the entry points and pin the CPU
+backend first (accelerator plugins in this image can hang backend init
+— the lint gate must never).
 """
 
 from __future__ import annotations
@@ -21,6 +24,10 @@ def _cmd_lint(args):
     from raft_tpu.analysis import lint
 
     findings = lint.lint_paths(args.paths or None)
+    if not args.paths:
+        # the dead-entry audit only makes sense over the full scan set
+        # (a partial path list would flag every registration as dead)
+        findings.extend(lint.registered_unused())
     for f in findings:
         print(f.format())
     if findings:
@@ -29,6 +36,67 @@ def _cmd_lint(args):
         return 1
     print("lint clean "
           f"({len(args.paths) or len(lint.default_paths())} files).")
+    return 0
+
+
+def _cmd_concurrency(args):
+    from raft_tpu.analysis import concurrency
+
+    findings = concurrency.analyze_paths(args.paths or None)
+    for f in findings:
+        print(f.format())
+    if findings:
+        print(f"{len(findings)} finding(s). Suppress audited exceptions "
+              "with `# raft-lint: disable=<rule>`.", file=sys.stderr)
+        return 1
+    scope = (f"{len(args.paths)} file(s)" if args.paths
+             else "shared-state + serve modules")
+    print(f"concurrency invariants clean ({scope}).")
+    return 0
+
+
+def _cmd_schemas(args):
+    from raft_tpu.analysis import schemas
+
+    if args.fixture:
+        violations, _ = schemas.run_fixture_checks()
+        for v in violations:
+            print(v)
+        if not violations:
+            print("schema drift fixture produced NO violations — the "
+                  "engine is broken", file=sys.stderr)
+            return 2
+        print(f"{len(violations)} violation(s) (seeded fixture drill).",
+              file=sys.stderr)
+        return 1
+    if args.write:
+        contracts = schemas.extract_all()
+        drift = []
+        for name, contract in contracts.items():
+            drift.extend(schemas.drift_violations(name, contract))
+        if drift:
+            # never bake live writer/reader drift into the baseline
+            for v in drift:
+                print(v, file=sys.stderr)
+            print("refusing to write a baseline over live drift",
+                  file=sys.stderr)
+            return 1
+        path = schemas.write_baseline(contracts)
+        print(f"schema baseline written: {path} "
+              f"({len(contracts)} families)")
+        return 0
+    violations, contracts = schemas.run_checks()
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} schema-contract violation(s). "
+              "Intentional evolution: `python -m raft_tpu.analysis "
+              "schemas --write` and commit the diff.", file=sys.stderr)
+        return 1
+    n_keys = sum(len(c["written"]) + len(c["read"])
+                 for c in contracts.values())
+    print(f"schema contracts clean ({len(contracts)} families, "
+          f"{n_keys} keys).")
     return 0
 
 
@@ -86,6 +154,26 @@ def main(argv=None):
     p.add_argument("paths", nargs="*", help="files to lint "
                    "(default: raft_tpu/ + bench.py + sweep_10k.py)")
 
+    p = sub.add_parser(
+        "concurrency",
+        help="concurrency invariants: atomic-write, async-blocking, "
+             "lock-discipline, thread-hygiene")
+    p.add_argument("paths", nargs="*",
+                   help="files to analyze with every rule forced on "
+                        "(default: the audited shared-state + serve "
+                        "modules with per-module rule gating)")
+
+    p = sub.add_parser(
+        "schemas",
+        help="cross-process writer/reader schema contracts vs the "
+             "checked-in analysis/schema_baseline.json")
+    p.add_argument("--write", action="store_true",
+                   help="regenerate the baseline (intentional schema "
+                        "evolution; refuses over live drift)")
+    p.add_argument("--fixture", action="store_true",
+                   help="run the seeded drifted-lease fixture drill "
+                        "(must exit 1 — the CI negative)")
+
     for name in ("contracts", "baseline"):
         p = sub.add_parser(
             name, help=("check jaxpr contracts + primitive budgets"
@@ -101,7 +189,8 @@ def main(argv=None):
     sub.add_parser("flags", help="list the RAFT_TPU_* flag registry")
 
     args = ap.parse_args(argv)
-    cmd = {"lint": _cmd_lint, "contracts": _cmd_contracts,
+    cmd = {"lint": _cmd_lint, "concurrency": _cmd_concurrency,
+           "schemas": _cmd_schemas, "contracts": _cmd_contracts,
            "baseline": _cmd_baseline, "flags": _cmd_flags}[args.cmd]
     return cmd(args)
 

@@ -48,6 +48,14 @@ codebase (or was fixed by hand in PR 2 and must stay fixed):
     unregistered name silently forks the span hierarchy and mints a
     stray ``span_<name>_s`` histogram nobody is reading.
 
+``registered-unused`` (whole-scan audit, not a per-file rule)
+    Dead registry entries: events/spans in :mod:`raft_tpu.obs.events`
+    that nothing emits, ``RAFT_TPU_*`` flags in
+    :mod:`raft_tpu.utils.config` that nothing reads, and registered
+    flags missing from the README flag tables.  Runs when the CLI
+    lints the DEFAULT scan set (a partial path list would flag every
+    registration as dead); see :func:`registered_unused`.
+
 Suppression: append ``# raft-lint: disable=<rule>[,<rule>]`` to the
 offending line (or put it alone on the line above); a file-level
 ``# raft-lint: disable-file=<rule>`` comment disables a rule for the
@@ -535,5 +543,140 @@ def lint_paths(paths=None, root=None):
     findings = []
     for p in expanded:
         findings.extend(lint_file(p))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ----------------------------------------------- registered-unused audit
+
+
+class _UsageCollector(ast.NodeVisitor):
+    """Literal usages of registered names across one file: event names
+    (``log_event("x", ...)`` and ``{"event": "x"}`` dict records), span
+    names (``span("x", ...)``), and flag names (``config.get/raw/
+    env_name("X")`` plus bare ``get/raw/env_name`` inside the registry
+    module itself)."""
+
+    def __init__(self):
+        self.events = set()
+        self.spans = set()
+        self.flags = set()
+
+    @staticmethod
+    def _str_arg(node):
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            return node.args[0].value
+        return None
+
+    def visit_Call(self, node):
+        fn = node.func
+        name = (fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else None)
+        arg = self._str_arg(node)
+        if arg is not None:
+            if name == "log_event":
+                self.events.add(arg)
+            elif name == "span":
+                self.spans.add(arg)
+            elif name in ("get", "raw", "env_name") \
+                    and isinstance(fn, ast.Attribute) \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "config":
+                # receiver-checked: `anydict.get("X")` must not mark a
+                # flag as read — only the registry module's accessors do
+                self.flags.add(arg)
+        self.generic_visit(node)
+
+    def visit_Dict(self, node):
+        # hand-built records ({"event": "proc_start", ...}) emit events
+        # without going through log_event (the structlog clock anchor)
+        for k, v in zip(node.keys, node.values):
+            if isinstance(k, ast.Constant) and k.value == "event" \
+                    and isinstance(v, ast.Constant) \
+                    and isinstance(v.value, str):
+                self.events.add(v.value)
+        self.generic_visit(node)
+
+
+def _registration_line(lines, needle):
+    """1-based line of the first occurrence of ``needle`` in the
+    preloaded source ``lines`` (for pointing a dead-entry finding at
+    its registration; each registry file is read once, not per name)."""
+    for i, text in enumerate(lines, start=1):
+        if needle in text:
+            return i
+    return 1
+
+
+def _source_lines(path):
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read().splitlines()
+    except OSError:
+        return []
+
+
+def registered_unused(root=None):
+    """Dead-entry audit over the full scan set: events/spans registered
+    in :mod:`raft_tpu.obs.events` that no scanned file ever emits, and
+    ``RAFT_TPU_*`` flags registered in :mod:`raft_tpu.utils.config`
+    that nothing reads — plus README flag-table completeness (every
+    registered flag must appear in the README; an undocumented knob is
+    indistinguishable from a typo'd one).  Returns :class:`Finding`
+    rows anchored at the dead registration.  Only meaningful over the
+    DEFAULT scan set — partial path lists would flag everything."""
+    root = root or repo_root()
+    used = _UsageCollector()
+    for p in default_paths(root):
+        try:
+            with open(p, encoding="utf-8") as f:
+                used.visit(ast.parse(f.read(), filename=p))
+        except (OSError, SyntaxError):
+            continue
+    findings = []
+    events_lines = _source_lines(
+        os.path.join(root, "raft_tpu", "obs", "events.py"))
+    events_disp = "raft_tpu/obs/events.py"
+    try:
+        from raft_tpu.obs.events import EVENTS, SPANS
+    except Exception:
+        EVENTS, SPANS = {}, {}
+    for name in sorted(set(EVENTS) - used.events):
+        findings.append(Finding(
+            events_disp, _registration_line(events_lines, f'"{name}"'), 1,
+            "registered-unused",
+            f"event {name!r} is registered but no scanned file ever "
+            "emits it — emit it or prune the registration"))
+    for name in sorted(set(SPANS) - used.spans):
+        findings.append(Finding(
+            events_disp, _registration_line(events_lines, f'"{name}"'), 1,
+            "registered-unused",
+            f"span {name!r} is registered in SPANS but no scanned file "
+            "ever opens it — open it or prune the registration"))
+    config_lines = _source_lines(
+        os.path.join(root, "raft_tpu", "utils", "config.py"))
+    config_disp = "raft_tpu/utils/config.py"
+    try:
+        from raft_tpu.utils.config import FLAGS
+    except Exception:
+        FLAGS = {}
+    readme = "\n".join(_source_lines(os.path.join(root, "README.md")))
+    for name in sorted(FLAGS):
+        if name not in used.flags:
+            findings.append(Finding(
+                config_disp,
+                _registration_line(config_lines, f'Flag("{name}"'), 1,
+                "registered-unused",
+                f"flag RAFT_TPU_{name} is registered but nothing reads "
+                "it (config.get/raw/env_name) — read it or prune the "
+                "registration"))
+        if readme and f"RAFT_TPU_{name}" not in readme:
+            findings.append(Finding(
+                config_disp,
+                _registration_line(config_lines, f'Flag("{name}"'), 1,
+                "registered-unused",
+                f"flag RAFT_TPU_{name} is registered but undocumented "
+                "in README.md — every knob must appear in a flag table"))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

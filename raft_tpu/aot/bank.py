@@ -283,8 +283,8 @@ def _atomic_write(path, data):
 #: refuse the query), updated per dispatch by :class:`BankedProgram`.
 #: Guarded by ``_STATS_LOCK``: the batcher tick thread mutates it while
 #: ``/healthz`` (asyncio thread) iterates ``ledger_summary``.
-PROGRAM_STATS: dict[str, dict] = {}
 _STATS_LOCK = threading.Lock()
+PROGRAM_STATS: dict[str, dict] = {}  # raft-lint: guarded-by=_STATS_LOCK
 
 
 def cost_analysis_dict(compiled, args=None):

@@ -44,7 +44,7 @@ class Counter:
     def __init__(self, name):
         self.name = name
         self._lock = threading.Lock()
-        self._value = 0
+        self._value = 0  # raft-lint: guarded-by=self._lock
 
     def inc(self, n=1):
         with self._lock:
@@ -67,8 +67,8 @@ class Gauge:
     def __init__(self, name):
         self.name = name
         self._lock = threading.Lock()
-        self._value = None
-        self._max = None
+        self._value = None  # raft-lint: guarded-by=self._lock
+        self._max = None  # raft-lint: guarded-by=self._lock
 
     def set(self, v):
         v = float(v)
@@ -98,12 +98,12 @@ class Histogram:
     def __init__(self, name):
         self.name = name
         self._lock = threading.Lock()
-        self.count = 0
-        self.sum = 0.0
-        self.min = None
-        self.max = None
+        self.count = 0  # raft-lint: guarded-by=self._lock
+        self.sum = 0.0  # raft-lint: guarded-by=self._lock
+        self.min = None  # raft-lint: guarded-by=self._lock
+        self.max = None  # raft-lint: guarded-by=self._lock
         # len(BUCKET_BOUNDS) + 1: trailing overflow bucket (+inf)
-        self._buckets = [0] * (len(BUCKET_BOUNDS) + 1)
+        self._buckets = [0] * (len(BUCKET_BOUNDS) + 1)  # raft-lint: guarded-by=self._lock
 
     def observe(self, v):
         v = float(v)
@@ -238,8 +238,8 @@ class Window:
     def __init__(self, name, maxlen=4096):
         self.name = name
         self._lock = threading.Lock()
-        self._buf = deque(maxlen=int(maxlen))
-        self.total = 0  # lifetime observation count (ring drops old)
+        self._buf = deque(maxlen=int(maxlen))  # raft-lint: guarded-by=self._lock
+        self.total = 0  # lifetime count  # raft-lint: guarded-by=self._lock
 
     def observe(self, v, t=None):
         t = time.perf_counter() if t is None else float(t)
@@ -283,7 +283,7 @@ class Window:
 
 
 _REGISTRY_LOCK = threading.Lock()
-_REGISTRY: dict[str, object] = {}
+_REGISTRY: dict[str, object] = {}  # raft-lint: guarded-by=_REGISTRY_LOCK
 
 
 def _get(name, cls):
@@ -407,10 +407,29 @@ def to_prometheus():
 
 def export(path):
     """Write :func:`to_prometheus` to ``path`` (best-effort: exporting
-    metrics must never take down the run that produced them)."""
+    metrics must never take down the run that produced them).
+
+    Atomic tmp + ``os.replace``: the export path is re-written at every
+    sweep_done / serve drain while scrapers and ``obs runs`` readers
+    may be mid-read — a plain truncate-and-write would hand them half
+    an exposition (the ``atomic-write`` concurrency-lint class)."""
+    import os
+    import tempfile
+
     try:
-        with open(path, "w") as f:
-            f.write(to_prometheus())
+        d = os.path.dirname(os.path.abspath(path))
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".",
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(to_prometheus())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         return True
     except OSError:
         return False

@@ -128,11 +128,11 @@ class Batcher:
             config.get("SERVE_QPS"), config.get("SERVE_BURST"))
         self.queue_bound = (int(config.get("SERVE_QUEUE"))
                             if queue_bound is None else int(queue_bound))
-        self._pending: deque[_Request] = deque()
         self._cond = threading.Condition()
-        self._draining = False
-        self._stop = False
-        self._in_tick = False
+        self._pending: deque[_Request] = deque()  # raft-lint: guarded-by=self._cond
+        self._draining = False  # raft-lint: guarded-by=self._cond
+        self._stop = False  # raft-lint: guarded-by=self._cond
+        self._in_tick = False  # raft-lint: guarded-by=self._cond
         self._thread = None
 
     # ------------------------------------------------------------ submit

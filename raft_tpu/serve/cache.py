@@ -87,11 +87,12 @@ class ResultCache:
         self.max_bytes = int(max_bytes)
         self._prefix = metrics_prefix
         self._lock = threading.Lock()
-        self._entries: OrderedDict[str, tuple[dict, int]] = OrderedDict()
-        self._bytes = 0
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        self._entries: OrderedDict[str, tuple[dict, int]] = \
+            OrderedDict()  # raft-lint: guarded-by=self._lock
+        self._bytes = 0  # raft-lint: guarded-by=self._lock
+        self.hits = 0  # raft-lint: guarded-by=self._lock
+        self.misses = 0  # raft-lint: guarded-by=self._lock
+        self.evictions = 0  # raft-lint: guarded-by=self._lock
 
     def get(self, key):
         """The cached row for ``key`` (a shallow copy — callers slice

@@ -385,19 +385,25 @@ class Server:
         for t in list(self._handlers):
             t.cancel()
         await self._server.wait_closed()
-        # 4. flush metrics for the scrape-at-exit consumers
+        # 4. flush metrics for the scrape-at-exit consumers — file IO,
+        #    so off the loop: open handlers are still writing their
+        #    final responses while this runs (async-blocking lint)
         path = config.get("METRICS")
         if path:
-            metrics.export(path)
+            await loop.run_in_executor(None, metrics.export, path)
         # 5. append the session's run record (RAFT_TPU_RUNS_DIR): the
         #    metrics registry at drain carries the whole serving story
         #    — request/stage/occupancy histograms, waste counters,
-        #    cost ledger — so the longitudinal store sees every session
+        #    cost ledger — so the longitudinal store sees every session.
+        #    Executor too: the record write is file IO plus a
+        #    `git rev-parse` subprocess (obs.runs.git_sha)
         from raft_tpu.obs import runs as obs_runs
 
-        obs_runs.maybe_record(
-            "serve", wall_s=time.perf_counter() - _T0,
-            extra={"requests": metrics.counter("serve_requests").value})
+        wall_s = time.perf_counter() - _T0
+        requests = metrics.counter("serve_requests").value
+        await loop.run_in_executor(
+            None, lambda: obs_runs.maybe_record(
+                "serve", wall_s=wall_s, extra={"requests": requests}))
         log_event("serve_stop",
                   requests=metrics.counter("serve_requests").value,
                   wall_s=round(time.perf_counter() - t0, 3))

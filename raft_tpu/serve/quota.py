@@ -31,10 +31,10 @@ class TokenBucket:
     def __init__(self, rate, burst, clock=time.monotonic):
         self.rate = float(rate)
         self.burst = max(float(burst), 1.0)
-        self._tokens = self.burst
-        self._clock = clock
-        self._t = clock()
         self._lock = threading.Lock()
+        self._tokens = self.burst  # raft-lint: guarded-by=self._lock
+        self._clock = clock
+        self._t = clock()  # raft-lint: guarded-by=self._lock
 
     def acquire(self, n=1):
         """Take ``n`` tokens; False when the bucket is dry."""
@@ -82,7 +82,7 @@ class ClientQuotas:
         self._clock = clock
         self._max = int(max_clients)
         self._lock = threading.Lock()
-        self._buckets: dict[str, TokenBucket] = {}
+        self._buckets: dict[str, TokenBucket] = {}  # raft-lint: guarded-by=self._lock
 
     def bucket(self, client):
         client = str(client or "anonymous")

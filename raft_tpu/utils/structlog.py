@@ -43,13 +43,13 @@ import uuid
 from raft_tpu.utils import config
 
 _T0 = time.perf_counter()
-_SINK = None
-_DEST = None
+_SINK = None  # raft-lint: guarded-by=_LOCK
+_DEST = None  # raft-lint: guarded-by=_LOCK
 # RLock: log_event re-resolves the sink while holding the lock (the
 # handle must not be swapped/closed between resolution and write by a
 # concurrent retarget), and _sink() itself locks the swap
 _LOCK = threading.RLock()
-_RUN_ID = None
+_RUN_ID = None  # raft-lint: guarded-by=_LOCK
 
 #: (trace_id, span_id) of the innermost active telemetry span in this
 #: task/thread; managed by :class:`raft_tpu.obs.spans.span`.
@@ -120,7 +120,7 @@ def enabled():
 #: dests this process has written its clock anchor to (the merge
 #: tooling needs one ``proc_start`` per (process, sink) to map the
 #: monotonic ``t`` column onto a shared wall clock)
-_ANCHORED: set = set()
+_ANCHORED: set = set()  # raft-lint: guarded-by=_LOCK
 
 
 def _anchor_record():
