@@ -72,6 +72,14 @@ python -m raft_tpu.aot verify
 python -m raft_tpu.obs trace --merge tests/fixtures/obs \
     -o /tmp/raft_obs_merge_check.json --check > /dev/null
 
+# serving-fleet trace assembly: the checked-in router + replica shards
+# (a real kill/evict/drain session: router_request -> router_upstream
+# spans in the router shard, the replica's serve_request spans
+# adopting the router's forwarded traceparent as their remote parent)
+# must merge with 0 orphan spans — the router propagation contract
+python -m raft_tpu.obs trace --merge tests/fixtures/obs_router \
+    -o /tmp/raft_obs_router_merge_check.json --check > /dev/null
+
 # perf-regression sentinel: against the checked-in baseline record,
 # the clean fixture run must PASS (exit 0) and the regressed fixture
 # (5x shard wall, dropped throughput, doubled padding waste) must be

@@ -96,6 +96,8 @@ _RES = "raft_tpu/parallel/resilience.py"
 _RUNS = "raft_tpu/obs/runs.py"
 _OBS_CLI = "raft_tpu/obs/__main__.py"
 _BANK = "raft_tpu/aot/bank.py"
+_FLEET = "raft_tpu/serve/fleet.py"
+_ROUTER = "raft_tpu/serve/router.py"
 
 FAMILIES: tuple[Family, ...] = (
     Family(
@@ -158,6 +160,27 @@ FAMILIES: tuple[Family, ...] = (
                  Site(_RUNS, "regress_records", "new"),
                  Site(_RUNS, "regress_records", "base"),
                  Site(_OBS_CLI, "_cmd_runs_list", "rec"))),
+    Family(
+        "fleet-lease",
+        "serving-fleet replica membership lease (_fleet/replicas/; "
+        "claim = join, renewed = alive, expired = dead, release = "
+        "drain — raft_tpu.serve.fleet)",
+        writers=(Site(_FLEET, "FleetLedger.claim", "rec"),
+                 Site(_FLEET, "FleetLedger.renew", "rec", kind="update")),
+        readers=(Site(_FLEET, "FleetLedger.renew", "rec"),
+                 Site(_FLEET, "FleetLedger.release", "rec"),
+                 Site(_FLEET, "FleetLedger.lease_age", "rec"),
+                 Site(_FLEET, "FleetLedger.live", "rec"),
+                 Site(_FLEET, "FleetLedger.expired", "rec"),
+                 Site(_FLEET, "FleetLedger.summary", "rec"),
+                 Site(_ROUTER, "RouterState.apply_membership", "rec"),
+                 Site(_ROUTER, "LedgerProber.probe_once", "rec"))),
+    Family(
+        "router-membership",
+        "the router's published membership view (_fleet/router.json: "
+        "ring replicas + breaker states, advisory)",
+        writers=(Site(_ROUTER, "RouterState.membership_record", "rec"),),
+        readers=(Site(_FLEET, "FleetLedger.summary", "router"),)),
     Family(
         "aot-sidecar", "AOT bank entry .json metadata sidecar",
         writers=(Site(_BANK, "entry_key", "meta"),

@@ -48,9 +48,12 @@ def _cmd_warmup(args):
     sizes = [int(s) for s in args.n.split(",") if s.strip()]
     kinds = [k.strip() for k in args.kinds.split(",") if k.strip()]
     out_keys = tuple(k.strip() for k in args.out_keys.split(",") if k.strip())
+    designs = list(args.design or ())
     try:
-        reports = warmup.warmup_model(design=args.design, sizes=sizes,
-                                      kinds=kinds, out_keys=out_keys)
+        reports = warmup.warmup_model(
+            design=designs[0] if designs else None, sizes=sizes,
+            kinds=kinds, out_keys=out_keys,
+            designs=designs if len(designs) > 1 else None)
     except ValueError as e:   # e.g. a typo'd --kinds entry
         print(str(e), file=sys.stderr)
         return 2
@@ -134,8 +137,11 @@ def main(argv=None):
 
     p = sub.add_parser("warmup", help="lower+compile+export the sweep "
                                       "programs for a design")
-    p.add_argument("--design", default=None,
-                   help="design YAML (default: bundled spar_demo)")
+    p.add_argument("--design", action="append", default=None,
+                   help="design YAML (default: bundled spar_demo); "
+                        "repeatable — several designs warm the serve "
+                        "kind's whole fleet design set in one pass "
+                        "(deduplicated by bucket signature)")
     p.add_argument("--n", default="8",
                    help="comma list of batch sizes to warm (rounded up "
                         "to the dp mesh-axis size)")

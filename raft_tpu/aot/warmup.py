@@ -90,9 +90,14 @@ def _round_up(n, multiple):
 
 
 def warmup_model(design=None, sizes=(8,), kinds=DEFAULT_KINDS,
-                 out_keys=DEFAULT_OUT_KEYS, mesh=None):
-    """Warm the bank for one design.  Returns a list of per-program
-    report dicts (kind, rows, loaded/compiled, seconds)."""
+                 out_keys=DEFAULT_OUT_KEYS, mesh=None, designs=None):
+    """Warm the bank for one design (``designs`` — a list of YAML
+    paths — warms the ``serve`` kind for SEVERAL, deduplicated by
+    bucket signature: the one-warmup-for-N-replicas recipe of the
+    serving fleet, where the coordinator pays the compile bill once
+    and every replica starts under ``RAFT_TPU_AOT=require``).
+    Returns a list of per-program report dicts (kind, rows,
+    loaded/compiled, seconds)."""
     import jax
 
     import raft_tpu
@@ -114,11 +119,16 @@ def warmup_model(design=None, sizes=(8,), kinds=DEFAULT_KINDS,
         mesh = make_mesh()
     dp = mesh.shape.get("dp", mesh.devices.size)
 
-    # the single-design model only feeds the non-bucketed kinds; a
-    # bucketed-only warmup must not pay its YAML load + host build
+    # the single-design model only feeds the non-bucketed sweep kinds
+    # (cases/full/design) — and the serve kind only when no explicit
+    # `designs` list supplies its entries: a bucketed-only or
+    # designs-driven serve warmup must not pay a YAML load + host
+    # build it never uses
     evaluators = {}
     model = None
-    if set(kinds) - {"bucketed"}:
+    need_model = bool(set(kinds) - {"bucketed", "serve"}) \
+        or ("serve" in kinds and not designs)
+    if need_model:
         if design is None:
             design = os.path.join(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__))), "designs", "spar_demo.yaml")
@@ -216,14 +226,28 @@ def warmup_model(design=None, sizes=(8,), kinds=DEFAULT_KINDS,
                 reports.append(rep)
 
         if "serve" in kinds:
-            # the evaluation service's programs: the design's bucketed
+            # the evaluation service's programs: each design's bucketed
             # single-case evaluator at every padded batch size of the
             # batcher's ladder — sizes come from RAFT_TPU_SERVE_MAX_BATCH
             # (NOT --n), because the bank keys on input avals and the
-            # server dispatches exactly these ladder rungs
+            # server dispatches exactly these ladder rungs.  `designs`
+            # warms a whole fleet's design set in one pass (engine.warm
+            # groups entries by bucket signature, so N same-bucket
+            # designs still compile each ladder rung exactly once)
             from raft_tpu.serve import engine as serve_engine
 
-            entry = serve_engine.DesignEntry("warmup", model)
-            reports += serve_engine.warm([entry], mesh=mesh,
+            entries = []
+            if designs:
+                for i, path in enumerate(designs):
+                    # a mixed-kind warmup already built `model` for the
+                    # first design — reuse it, don't pay a second YAML
+                    # load + host build
+                    m = (model if model is not None and path == design
+                         else raft_tpu.Model(path))
+                    entries.append(serve_engine.DesignEntry(
+                        f"warmup{i}", m))
+            else:
+                entries.append(serve_engine.DesignEntry("warmup", model))
+            reports += serve_engine.warm(entries, mesh=mesh,
                                          out_keys=tuple(out_keys))
     return reports

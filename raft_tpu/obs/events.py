@@ -221,6 +221,67 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
     "serve_stop": (
         ("requests", "wall_s"),
         "the service exited after draining and flushing metrics"),
+    # ------------------------------------------------------ serving fleet
+    "replica_join": (
+        ("replica", "port", "designs", "root"),
+        "a warmed replica claimed its membership lease in the _fleet/ "
+        "ledger (O_CREAT|O_EXCL — raft_tpu.serve.fleet); the router "
+        "admits it to the hash ring on its next prober pass"),
+    "replica_drain": (
+        ("replica", "reason", "root"),
+        "a replica released its membership lease at drain START "
+        "(SIGTERM / POST /drain): the router stops routing new work "
+        "here while the accepted work finishes"),
+    "replica_evict": (
+        ("replica", "reason", "age_s", "root"),
+        "an expired membership lease was atomically removed (dead "
+        "replica: SIGKILL/OOM/wedged host — exactly one evictor wins "
+        "the rename) and the replica leaves the hash ring"),
+    "fleet_spawn": (
+        ("root", "replica", "pid"),
+        "the fleet coordinator spawned one replica server subprocess"),
+    "router_start": (
+        ("host", "port", "fleet_dir", "n_replicas", "replicas"),
+        "the failover router bound its socket (after the first "
+        "membership pass populated the ring)"),
+    "router_stop": (
+        ("requests", "retries"),
+        "the router exited after letting in-flight proxied requests "
+        "finish"),
+    "router_ring_update": (
+        ("added", "removed", "n_replicas"),
+        "the membership prober reconciled the hash ring against the "
+        "lease ledger (join/drain/evict — zero router restarts)"),
+    "router_request": (
+        ("replica", "code", "attempts", "hedged", "design", "wall_s"),
+        "one proxied /evaluate resolved: which replica answered, the "
+        "final HTTP code, and how many failover attempts it took "
+        "(replica=None on a 503 rejection)"),
+    "router_retry": (
+        ("replica", "attempt", "reason", "delay_s"),
+        "the failover ladder moved a request to the next ring replica "
+        "after a backoff (reason: connect | dropped | timeout | "
+        "http_5xx) — duplicate dispatch is benign by construction "
+        "(content-addressed result/program caches)"),
+    "router_hedge": (
+        ("primary", "replica", "hedge_ms"),
+        "a hedged copy of a straggling first attempt was fired at the "
+        "next ring replica (RAFT_TPU_ROUTER_HEDGE_MS); first good "
+        "response wins"),
+    "router_reject": (
+        ("reason", "attempts", "retry_after_s"),
+        "every owning replica was dead or breaker-open: the client got "
+        "503 + Retry-After (graceful degradation, never a dropped "
+        "connection)"),
+    "breaker_open": (
+        ("replica", "reason", "fails", "cooldown_s"),
+        "a replica's circuit breaker opened after consecutive upstream "
+        "failures; no traffic until the cooldown's half-open trial"),
+    "breaker_close": (
+        ("replica", "probe?"),
+        "a half-open trial (live request, or probe=true for the "
+        "prober's /healthz recovery check) succeeded and the "
+        "replica's breaker closed"),
     # --------------------------------------------- run-record store
     "run_record": (
         ("kind", "path", "label?"),
@@ -301,6 +362,14 @@ SPANS: dict[str, str] = {
                      "(adopts the client's traceparent when sent)",
     "serve_tick": "one non-empty batcher tick; `links` names every "
                   "coalesced request span it dispatched for",
+    "router_request": "one proxied /evaluate at the fleet router, "
+                      "HTTP accept through the failover ladder to the "
+                      "response (adopts the client's traceparent; its "
+                      "ids are forwarded so the replica's serve_request "
+                      "span joins the same trace)",
+    "router_upstream": "one upstream attempt of the failover ladder "
+                       "(child of router_request; retries and hedges "
+                       "each get their own)",
 }
 
 

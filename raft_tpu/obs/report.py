@@ -379,6 +379,42 @@ def report_data(events, n_bad=0, source="<events>"):
          "p95_s": _percentile(rec["walls"], 0.95),
          "max_s": max(rec["walls"])}
         for (ep, code), rec in sorted(endpoints.items())]
+    # fleet-router table: per-(answering replica, code) routed-request
+    # rows from router_request events, plus the failover-ladder
+    # summary (retries, hedges, breaker transitions, evictions) — the
+    # kill-a-replica drill reads its "zero dropped responses" story
+    # from here.  One pass: the ladder counters ride the same loop.
+    routed = {}
+    _ROUTER_COUNT_EVENTS = ("router_retry", "router_hedge",
+                            "router_reject", "breaker_open",
+                            "breaker_close", "replica_join",
+                            "replica_drain", "replica_evict",
+                            "router_ring_update")
+    router_counts = dict.fromkeys(_ROUTER_COUNT_EVENTS, 0)
+    for e in events:
+        if e["event"] in router_counts:
+            router_counts[e["event"]] += 1
+            continue
+        if e["event"] != "router_request":
+            continue
+        key = (str(e.get("replica") or "-"), int(e.get("code") or 0))
+        rec = routed.setdefault(key, {"walls": [], "attempts": 0,
+                                      "hedged": 0})
+        rec["walls"].append(e.get("wall_s") or 0.0)
+        rec["attempts"] += int(e.get("attempts") or 1)
+        if e.get("hedged"):
+            rec["hedged"] += 1
+    router_rows = [
+        {"replica": rid, "code": code, "requests": len(rec["walls"]),
+         "attempts": rec["attempts"], "hedged": rec["hedged"],
+         "p50_s": _percentile(rec["walls"], 0.50),
+         "p95_s": _percentile(rec["walls"], 0.95),
+         "max_s": max(rec["walls"])}
+        for (rid, code), rec in sorted(routed.items())]
+    router_summary = None
+    if router_rows or any(router_counts.values()):
+        router_summary = {"replicas": router_rows, **router_counts}
+
     ticks = [e for e in events if e["event"] == "serve_tick"]
     tick_summary = None
     if ticks:
@@ -484,6 +520,7 @@ def report_data(events, n_bad=0, source="<events>"):
         "workers": worker_rows,
         "serve": ({"endpoints": endpoint_rows, "ticks": tick_summary}
                   if endpoint_rows or ticks else None),
+        "router": router_summary,
         "serve_stages": serve_stage_attribution(events),
         "cost_ledger": ({"occupancy": occupancy, "programs": ledger_rows}
                         if ledger_rows else None),
@@ -591,6 +628,29 @@ def render_report(events, n_bad=0, source="<events>"):
                 f"{t['dispatches']} dispatches; "
                 f"mean batch {t['mean_batch']:.1f}, "
                 f"tick p95 {t['p95_s']:.3f}s)")
+
+    router = data["router"]
+    if router:
+        out.append("")
+        out.append("fleet router (replica / code / requests / attempts "
+                   "/ hedged / p50 / p95 / max)")
+        for r in router["replicas"]:
+            out.append(
+                f"  {r['replica']:20s} {r['code']:4d} {r['requests']:8d} "
+                f"{r['attempts']:8d} {r['hedged']:6d} "
+                f"{_fmt_s(r['p50_s'])} "
+                f"{_fmt_s(r['p95_s'])} "
+                f"{_fmt_s(r['max_s'])}")
+        out.append(
+            f"  ladder: {router['router_retry']} retries, "
+            f"{router['router_hedge']} hedges, "
+            f"{router['router_reject']} rejects; breakers "
+            f"{router['breaker_open']} opened / "
+            f"{router['breaker_close']} closed; membership "
+            f"{router['replica_join']} joins / "
+            f"{router['replica_drain']} drains / "
+            f"{router['replica_evict']} evictions "
+            f"({router['router_ring_update']} ring updates)")
 
     attrib = data["serve_stages"]
     if attrib:
@@ -773,11 +833,15 @@ def chrome_trace(events, merged=False):
     # — in a properly-propagated multi-process merge every worker root
     # chains to the coordinator's sweep span and every serve dispatch
     # to its tick, so the merged count must be 0 (the acceptance gate
-    # `obs trace --merge --check` enforces).  Exception: a span whose
-    # parent came from an EXTERNAL tracer (remote_parent, e.g. a traced
-    # HTTP client sending `traceparent`) when no other process in the
-    # capture contributed to its trace — that parent legitimately lives
-    # in the client's telemetry, not ours.
+    # `obs trace --merge --check` enforces).  Exceptions, both for
+    # parents that legitimately live in an EXTERNAL tracer's telemetry:
+    # a remote_parent span in a trace no other captured process
+    # contributed to (a traced HTTP client hitting one server), and a
+    # span stamped boundary="client" (the fleet router adopting a
+    # client traceparent — its replicas' spans share the trace, but the
+    # parent is still the client's).  Internally-propagated parents
+    # (fabric coordinator -> workers, router -> replicas) get no
+    # excuse: they must resolve in-capture.
     ids = {s["span_id"] for s in spans} | {b.get("span_id")
                                            for b in unmatched}
     pids_by_trace: dict = {}
@@ -787,8 +851,9 @@ def chrome_trace(events, merged=False):
     for s in spans:
         if not s["parent_id"] or s["parent_id"] in ids:
             continue
-        if s["attrs"].get("remote_parent") and \
-                len(pids_by_trace.get(s["trace_id"], ())) <= 1:
+        if s["attrs"].get("remote_parent") and (
+                s["attrs"].get("boundary") == "client"
+                or len(pids_by_trace.get(s["trace_id"], ())) <= 1):
             continue
         orphans.append(s)
     meta = {"spans_matched": len(spans),

@@ -158,6 +158,65 @@ def load_cases(out_dir):
         return {k: z[k] for k in z.files}
 
 
+# ------------------------------------------------- atomic lease primitives
+#
+# The three filesystem idioms every RAFT_TPU lease ledger is built
+# from, factored out so other ledgers (the serving fleet's replica
+# membership in :mod:`raft_tpu.serve.fleet`) reuse the EXACT semantics
+# the sweep fabric trusts instead of re-deriving them: claim =
+# ``O_CREAT|O_EXCL`` (exactly one creator), rewrite = tmp +
+# ``os.replace`` (readers see old-or-new, never torn), steal/evict =
+# ``os.rename`` to a unique grave (exactly one winner).
+
+
+def lease_claim(path, rec):
+    """Exclusive lease creation: True when THIS caller won the
+    ``O_CREAT|O_EXCL`` race and wrote ``rec``."""
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    with os.fdopen(fd, "w") as f:
+        json.dump(rec, f)
+    return True
+
+
+def lease_read(path):
+    """``(record, mtime)`` of a lease file, or ``(None, None)`` when
+    absent.  A present-but-unreadable lease (claimant mid-write) reads
+    as an empty record with the file's mtime."""
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None, None
+    try:
+        with open(path) as f:
+            return json.load(f), mtime
+    except (OSError, ValueError):
+        return {}, mtime
+
+
+def lease_rewrite(path, rec):
+    """Atomic full rewrite of a lease record (renewals)."""
+    resilience._atomic_write(path, lambda f: json.dump(rec, f), mode="w")
+
+
+def lease_remove(path):
+    """Atomically remove a lease via rename to a unique grave: True
+    when THIS caller won the rename (steal/evict — the losing racer
+    sees False and must not double-count the removal)."""
+    grave = f"{path}.stolen.{uuid.uuid4().hex[:8]}"
+    try:
+        os.rename(path, grave)
+    except OSError:
+        return False
+    try:
+        os.unlink(grave)
+    except OSError:
+        pass
+    return True
+
+
 # ----------------------------------------------------------------- ledger
 
 
@@ -197,25 +256,12 @@ class Ledger:
         """``(record, mtime)`` of the shard's lease, or ``(None,
         None)``.  A present-but-unreadable lease (claimant mid-write)
         reads as an empty record with the file's mtime."""
-        path = _lease_path(self.out_dir, shard)
-        try:
-            mtime = os.path.getmtime(path)
-        except OSError:
-            return None, None
-        try:
-            with open(path) as f:
-                return json.load(f), mtime
-        except (OSError, ValueError):
-            return {}, mtime
+        return lease_read(_lease_path(self.out_dir, shard))
 
     def claim(self, shard, attempt=1):
         """Try to claim the shard; True when THIS caller won the
         exclusive lease-file creation."""
         path = _lease_path(self.out_dir, shard)
-        try:
-            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        except FileExistsError:
-            return False
         now = time.time()
         rec = {
             "worker": self.worker_id,
@@ -230,8 +276,8 @@ class Ledger:
         ids = ambient_ids()  # active span or env-inherited trace ctx
         if ids is not None:
             rec["trace_id"], rec["parent_span_id"] = ids
-        with os.fdopen(fd, "w") as f:
-            json.dump(rec, f)
+        if not lease_claim(path, rec):
+            return False
         metrics.counter("shards_claimed").inc()
         log_event("shard_claim", shard=shard, worker=self.worker_id,
                   attempt=int(attempt))
@@ -244,9 +290,7 @@ class Ledger:
         if not rec or rec.get("token") != self.token:
             return False
         rec["renewed_t"] = time.time()
-        resilience._atomic_write(
-            _lease_path(self.out_dir, shard),
-            lambda f: json.dump(rec, f), mode="w")
+        lease_rewrite(_lease_path(self.out_dir, shard), rec)
         return True
 
     def release(self, shard):
@@ -302,16 +346,8 @@ class Ledger:
         """Atomically remove a stealable lease (rename to a unique
         grave, then unlink).  True when THIS caller won the rename —
         the shard is unleased again and open to normal claims."""
-        path = _lease_path(self.out_dir, shard)
-        grave = f"{path}.stolen.{uuid.uuid4().hex[:8]}"
-        try:
-            os.rename(path, grave)
-        except OSError:
+        if not lease_remove(_lease_path(self.out_dir, shard)):
             return False  # someone else stole/released it first
-        try:
-            os.unlink(grave)
-        except OSError:
-            pass
         metrics.counter("shards_stolen").inc()
         log_event("shard_steal", shard=shard, worker=self.worker_id,
                   from_worker=holder, reason=reason,

@@ -37,7 +37,18 @@ new dependencies):
              ``GET /healthz``, ``GET /metrics``; graceful drain on
              SIGTERM (finish in-flight ticks, refuse new work, flush
              metrics)
-``client``   minimal stdlib client for load harnesses and tests
+``client``   minimal stdlib client for load harnesses and tests, with
+             the shared 429/503 backoff schedule (``backoff_delay``)
+``wire``     the shared HTTP/1.1 parser/formatter + the router's
+             asyncio upstream client
+``fleet``    replica membership for the horizontal serving fleet: a
+             ``_fleet/`` lease ledger on the fabric's atomic
+             primitives (claim = join, renewed = alive, expired =
+             dead, release = drain) + the local fleet coordinator
+``router``   the consistent-hash failover front: hash ring by (bucket
+             signature, design content hash), retry-with-backoff onto
+             the next replica, per-replica circuit breakers, hedged
+             requests, 503 + Retry-After only when nobody can answer
 
 Start a server::
 
@@ -45,8 +56,15 @@ Start a server::
     python -m raft_tpu.serve --designs spar=raft_tpu/designs/spar_demo.yaml \
         --port 8787
 
-See the README "Evaluation service" section for the API schema, the
-tick/batching model and the flag/event tables.
+Or a fault-tolerant fleet behind one endpoint::
+
+    python -m raft_tpu.serve fleet --replicas 2 --fleet-dir /srv/raft \
+        --designs spar=raft_tpu/designs/spar_demo.yaml --warm-bank
+    python -m raft_tpu.serve router --fleet-dir /srv/raft --port 8788
+
+See the README "Evaluation service" + "Serving fleet" sections for the
+API schema, the tick/batching model, the failover ladder and the
+flag/event tables.
 """
 
 from __future__ import annotations

@@ -1,8 +1,11 @@
-"""CLI for the always-on evaluation service.
+"""CLI for the always-on evaluation service + the serving fleet.
+
+Single server (a fleet **replica** when ``--fleet-dir`` is set)::
 
     python -m raft_tpu.serve --designs spar=raft_tpu/designs/spar_demo.yaml \
         [--designs semi=...] [--host 127.0.0.1] [--port 8787] \
-        [--out-keys PSD,X0,status] [--no-warm] [--platform cpu] [--x64]
+        [--out-keys PSD,X0,status] [--no-warm] [--platform cpu] [--x64] \
+        [--fleet-dir DEPLOY_DIR] [--replica-id r0]
 
 Startup order is the serving contract: build + pack every registered
 design, WARM every (bucket x batch-ladder) program through the AOT
@@ -13,24 +16,40 @@ startup, not mid-request; fill it first with
 
     python -m raft_tpu.aot warmup --kinds serve --design <yaml>
 
+With ``--fleet-dir`` the server additionally JOINS the serving fleet:
+after the socket binds it claims a membership lease in the
+``_fleet/`` ledger (port + bucket signatures + health snapshot in the
+lease body), renews it from a daemon thread, and releases it at drain
+START — see :mod:`raft_tpu.serve.fleet`.
+
+Fleet coordinator (N replicas warmed from the SAME bank)::
+
+    python -m raft_tpu.serve fleet --replicas 2 --fleet-dir DEPLOY_DIR \
+        --designs spar=... [--warm-bank] [--no-warm] [--status]
+
+Failover router (the one endpoint clients talk to)::
+
+    python -m raft_tpu.serve router --fleet-dir DEPLOY_DIR --port 8788
+
 ``--port 0`` binds an ephemeral port; the ready line on stdout
-(``serving N design(s) on http://host:port ...``) reports the actual
-one (load harnesses parse it).  SIGTERM/SIGINT drains gracefully:
-in-flight requests finish, new work gets 503, metrics flush to
-``RAFT_TPU_METRICS`` when set.
+(``serving N design(s) on http://host:port ...`` / ``routing N
+replica(s) ...``) reports the actual one (load harnesses parse it).
+SIGTERM/SIGINT drains gracefully: in-flight requests finish, new work
+gets 503, metrics flush to ``RAFT_TPU_METRICS``.
 
 Tuning flags (see ``python -m raft_tpu.analysis flags``):
-``RAFT_TPU_SERVE_TICK_MS``, ``SERVE_MAX_BATCH``, ``SERVE_CACHE_MB``,
-``SERVE_QUEUE``, ``SERVE_QPS``, ``SERVE_BURST``, ``SERVE_TIMEOUT_S``,
-``SERVE_DRAIN_S``.
+``RAFT_TPU_SERVE_*`` for replicas, ``RAFT_TPU_ROUTER_*`` for the
+failover ladder, ``RAFT_TPU_FLEET_*`` for membership leases.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import os
 import sys
+import uuid
 
 
 def _parse_designs(specs):
@@ -51,7 +70,13 @@ def _parse_designs(specs):
     return out
 
 
-def main(argv=None):
+def _default_fleet_dir(value):
+    from raft_tpu.utils import config
+
+    return value if value is not None else (config.get("FLEET_DIR") or None)
+
+
+def _serve_main(argv):
     ap = argparse.ArgumentParser(prog="python -m raft_tpu.serve")
     ap.add_argument("--designs", action="append", required=True,
                     help="name=design.yaml (repeatable / comma list)")
@@ -70,6 +95,12 @@ def main(argv=None):
     ap.add_argument("--x64", action="store_true",
                     help="serve under jax_enable_x64 (warm the bank with "
                          "--x64 too — x64 is part of the bank key)")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="join the serving fleet whose _fleet/ ledger "
+                         "lives under this directory (default: "
+                         "RAFT_TPU_FLEET_DIR when set)")
+    ap.add_argument("--replica-id", default=None,
+                    help="fleet replica id (default: a fresh unique id)")
     args = ap.parse_args(argv)
 
     from raft_tpu.utils import config
@@ -84,6 +115,7 @@ def main(argv=None):
         jax.config.update("jax_enable_x64", True)
 
     from raft_tpu.serve import engine
+    from raft_tpu.serve import fleet as fleet_mod
     from raft_tpu.serve.batcher import Batcher
     from raft_tpu.serve.http import run_server
     from raft_tpu.structure.bucketing import signature_fingerprint
@@ -114,15 +146,174 @@ def main(argv=None):
               f"({loaded} bank-loaded, {compiled} compiled) in {wall:.1f}s",
               flush=True)
 
+    fleet_root = _default_fleet_dir(args.fleet_dir)
+    fleet_state = {}
+
     def ready(server):
         print(f"serving {len(registry)} design(s) on "
               f"http://{server.host}:{server.port} "
               f"(tick {batcher.tick_s * 1e3:.0f}ms, "
               f"batch ladder {list(batcher.sizes)})", flush=True)
+        if not fleet_root:
+            return
+        # join the fleet only AFTER warmup + bind: the router must
+        # never route to a replica that would trace on the request
+        rid = args.replica_id or f"replica-{uuid.uuid4().hex[:8]}"
+        ledger = fleet_mod.FleetLedger(fleet_root, replica_id=rid)
+        meta = {}
+        for name in registry.names():
+            e = registry.get(name)
+            meta[name] = {"sig": signature_fingerprint(e.sig),
+                          "fingerprint": e.fingerprint}
+
+        def healthz():
+            s = batcher.stats()
+            return {"draining": bool(s["draining"]),
+                    "pending": int(s["pending"]),
+                    "cache": s["cache"]}
+
+        buckets = sorted({m["sig"] for m in meta.values()})
+        if not ledger.claim(server.port, host=server.host, designs=meta,
+                            buckets=buckets, healthz=healthz()):
+            # a lease already exists under this forced id.  Only a
+            # crashed predecessor's EXPIRED lease may be evicted — a
+            # live one means another replica is serving under this id
+            # right now, and hijacking it would silently knock that
+            # replica out of the ring (its renewer fails token checks
+            # and never re-claims)
+            rec, mtime = ledger.read(rid)
+            ttl = float((rec or {}).get("ttl_s")
+                        or config.get("FLEET_TTL_S"))
+            age = (fleet_mod.FleetLedger.lease_age(rec, mtime)
+                   if rec is not None else float("inf"))
+            if rec is None or age > ttl:
+                ledger.evict(rid, reason="stale_self", age_s=age)
+                if not ledger.claim(server.port, host=server.host,
+                                    designs=meta, buckets=buckets,
+                                    healthz=healthz()):
+                    # lost the re-claim race to a same-id twin: joining
+                    # anyway would start a renewer that no-ops forever
+                    print(f"fleet: NOT joining {fleet_root} — lost the "
+                          f"claim race for {rid!r} (serving standalone)",
+                          file=sys.stderr)
+                    return
+            else:
+                print(f"fleet: NOT joining {fleet_root} — the lease for "
+                      f"{rid!r} is LIVE (age {age:.1f}s <= ttl {ttl:.1f}s); "
+                      "another replica is serving under this id.  Pick a "
+                      "different --replica-id (serving standalone).",
+                      file=sys.stderr)
+                return
+        renewer = fleet_mod.LeaseRenewer(ledger, healthz=healthz)
+        renewer.start()
+        fleet_state.update(ledger=ledger, renewer=renewer)
+        print(f"fleet: joined {fleet_root} as {rid}", flush=True)
+
+    def on_drain_start():
+        # release the membership lease at drain START (executor
+        # thread): the router stops routing here while accepted work
+        # finishes — the whole point of drain = release
+        renewer = fleet_state.get("renewer")
+        if renewer is not None:
+            renewer.stop()
+        ledger = fleet_state.get("ledger")
+        if ledger is not None:
+            ledger.release(reason="drain")
 
     asyncio.run(run_server(batcher, host=args.host, port=args.port,
+                           ready=ready, on_drain_start=on_drain_start))
+    return 0
+
+
+def _fleet_main(argv):
+    ap = argparse.ArgumentParser(prog="python -m raft_tpu.serve fleet")
+    ap.add_argument("--fleet-dir", default=None, required=False,
+                    help="fleet deploy directory (the _fleet/ ledger "
+                         "root; default: RAFT_TPU_FLEET_DIR)")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--designs", action="append", default=[],
+                    help="name=design.yaml, forwarded to every replica")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--out-keys", default=None,
+                    help="forwarded to every replica")
+    ap.add_argument("--no-warm", action="store_true",
+                    help="replicas skip their pre-bind warmup")
+    ap.add_argument("--warm-bank", action="store_true",
+                    help="warm the shared AOT bank ONCE in this process "
+                         "before spawning (pay the compile bill once; "
+                         "replicas then start under RAFT_TPU_AOT=require "
+                         "with zero backend compiles)")
+    ap.add_argument("--status", action="store_true",
+                    help="print the ledger summary as JSON and exit")
+    args = ap.parse_args(argv)
+
+    from raft_tpu.serve import fleet as fleet_mod
+
+    root = _default_fleet_dir(args.fleet_dir)
+    if not root:
+        print("--fleet-dir (or RAFT_TPU_FLEET_DIR) is required",
+              file=sys.stderr)
+        return 2
+    if args.status:
+        print(json.dumps(fleet_mod.FleetLedger(root).summary(), indent=1,
+                         default=str))
+        return 0
+    if not args.designs:
+        print("no designs (--designs name=path)", file=sys.stderr)
+        return 2
+    extra = []
+    if args.no_warm:
+        extra.append("--no-warm")
+    if args.out_keys:
+        extra += ["--out-keys", args.out_keys]
+
+    def on_ready(ports):
+        print(f"fleet ready: {len(ports)} replica(s) at "
+              + " ".join(f"{rid}=http://{args.host}:{p}"
+                         for rid, p in ports.items()), flush=True)
+
+    return fleet_mod.run_fleet(root, args.replicas, args.designs,
+                               host=args.host, extra_args=extra,
+                               warm_bank=args.warm_bank,
+                               on_ready=on_ready)
+
+
+def _router_main(argv):
+    ap = argparse.ArgumentParser(prog="python -m raft_tpu.serve router")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="fleet deploy directory (default: "
+                         "RAFT_TPU_FLEET_DIR)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8788,
+                    help="0 binds an ephemeral port (see the ready line)")
+    args = ap.parse_args(argv)
+
+    from raft_tpu.serve.router import run_router
+
+    root = _default_fleet_dir(args.fleet_dir)
+    if not root:
+        print("--fleet-dir (or RAFT_TPU_FLEET_DIR) is required",
+              file=sys.stderr)
+        return 2
+
+    def ready(router):
+        snap = router.state.snapshot()
+        print(f"routing {snap['n_replicas']} replica(s) on "
+              f"http://{router.host}:{router.port} "
+              f"(fleet {root})", flush=True)
+
+    asyncio.run(run_router(root, host=args.host, port=args.port,
                            ready=ready))
     return 0
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "fleet":
+        return _fleet_main(argv[1:])
+    if argv and argv[0] == "router":
+        return _router_main(argv[1:])
+    return _serve_main(argv)
 
 
 if __name__ == "__main__":

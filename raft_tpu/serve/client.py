@@ -1,15 +1,52 @@
-"""Minimal stdlib client for the evaluation service.
+"""Minimal stdlib client for the evaluation service / fleet router.
 
 Used by the bench load harness (``RAFT_TPU_BENCH_MODE=serve``) and the
 subprocess tests; keep-alive ``http.client`` connections so hundreds of
 synthetic clients stay cheap.  Not a public SDK — the wire format is
 plain JSON over HTTP (see :mod:`raft_tpu.serve.http`).
+
+Backpressure-aware retries: with ``retries=`` (default from
+``RAFT_TPU_SERVE_CLIENT_RETRIES``, 0 = off) a 429/503 response is
+retried after a capped exponential backoff that honors the server's
+``Retry-After`` — :func:`backoff_delay` is the ONE schedule shared by
+this client and the fleet router's failover ladder
+(:mod:`raft_tpu.serve.router`), so the bench load generator and the
+router back off identically.  Only CLEAN backpressure responses are
+retried; a dropped response stays :class:`ResponseDropped` (re-sending
+a possibly-accepted evaluate is the caller's call, never the
+client's).
 """
 
 from __future__ import annotations
 
 import http.client
 import json
+import random
+import time
+
+from raft_tpu.utils import config
+
+#: responses the client-side retry loop may re-send: both are CLEAN
+#: rejections (the request was never evaluated), so a re-send cannot
+#: duplicate work
+RETRYABLE_REJECTS = (429, 503)
+
+
+def backoff_delay(attempt, base_s=0.05, cap_s=2.0, retry_after_s=None,
+                  jitter=None):
+    """Delay before retry number ``attempt`` (0-based): capped
+    exponential ``min(cap_s, base_s * 2**attempt)``, overridden upward
+    by an explicit server ``Retry-After`` (the server knows its drain/
+    quota window better than any client-side curve), plus optional
+    jitter — ``jitter()`` in [0, 1) scales the delay by up to +100% so
+    a synchronized client herd de-synchronizes.  Deterministic when
+    ``jitter`` is None (unit tests pin the schedule)."""
+    d = min(float(cap_s), float(base_s) * (2.0 ** int(attempt)))
+    if retry_after_s is not None:
+        d = max(d, float(retry_after_s))
+    if jitter is not None:
+        d *= 1.0 + float(jitter())
+    return d
 
 
 class ResponseDropped(RuntimeError):
@@ -24,10 +61,20 @@ class ResponseDropped(RuntimeError):
 class ServeClient:
     """One keep-alive connection to a service instance."""
 
-    def __init__(self, host, port, client_id=None, timeout=300.0):
+    def __init__(self, host, port, client_id=None, timeout=300.0,
+                 retries=None, backoff_base_s=0.05, backoff_cap_s=2.0,
+                 jitter=True, sleep=time.sleep):
         self.host, self.port = host, int(port)
         self.client_id = client_id
         self.timeout = timeout
+        #: 429/503 retry budget (flag-gated: default
+        #: RAFT_TPU_SERVE_CLIENT_RETRIES, 0 = return rejections as-is)
+        self.retries = (int(config.get("SERVE_CLIENT_RETRIES"))
+                        if retries is None else int(retries))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self._jitter = random.random if jitter else None
+        self._sleep = sleep
         self._conn = None
         #: response headers of the last completed round trip (the
         #: distributed-tracing tests read `traceparent` back from here)
@@ -45,8 +92,36 @@ class ServeClient:
             self._conn = None
 
     def request(self, method, path, payload=None, headers=None):
-        """One round trip; returns ``(status_code, parsed_body)`` —
-        JSON-decoded when possible, raw text otherwise (``/metrics``)."""
+        """One logical request; returns ``(status_code, parsed_body)``
+        — JSON-decoded when possible, raw text otherwise
+        (``/metrics``).  With ``retries > 0``, clean 429/503
+        rejections are re-sent after :func:`backoff_delay` (the
+        server's ``Retry-After`` wins over the exponential curve)."""
+        for attempt in range(self.retries + 1):
+            status, body = self._round_trip(method, path, payload, headers)
+            if status not in RETRYABLE_REJECTS or attempt >= self.retries:
+                return status, body
+            self._sleep(backoff_delay(
+                attempt, self.backoff_base_s, self.backoff_cap_s,
+                retry_after_s=self._retry_after(body),
+                jitter=self._jitter))
+        raise AssertionError("unreachable: retry loop always returns")
+
+    def _retry_after(self, body):
+        """The server's retry hint: the ``Retry-After`` header
+        (integer seconds) or the payload's ``retry_after_s``."""
+        ra = self.last_headers.get("retry-after")
+        if ra is not None and str(ra).strip().isdigit():
+            return float(ra)
+        if isinstance(body, dict) and body.get("retry_after_s") is not None:
+            try:
+                return float(body["retry_after_s"])
+            except (TypeError, ValueError):
+                return None
+        return None
+
+    def _round_trip(self, method, path, payload=None, headers=None):
+        """One wire round trip (no retry policy)."""
         body = None
         headers = dict(headers or {})
         if payload is not None:

@@ -1,0 +1,421 @@
+"""Replica membership ledger of the horizontal serving fleet.
+
+ROADMAP item 4: one asyncio server became N **replica** processes, each
+running the existing :mod:`raft_tpu.serve` service warmed from the SAME
+immutable AOT bank, fronted by the consistent-hash failover router
+(:mod:`raft_tpu.serve.router`).  Membership/liveness is the fabric's
+lease model verbatim — this module reuses the atomic primitives the
+sweep fabric trusts (:func:`raft_tpu.parallel.fabric.lease_claim` /
+``lease_rewrite`` / ``lease_remove``):
+
+* **claim = join** — a replica that bound its socket (and finished its
+  bank warmup) claims ``<root>/_fleet/replicas/<rid>.json`` with
+  ``O_CREAT|O_EXCL``; the lease body carries its port, the bucket
+  signatures + design content fingerprints it serves (the router's
+  hash-ring routing keys) and a small health snapshot;
+* **renewed lease = alive** — a daemon renewer rewrites the lease
+  (tmp + ``os.replace``) every ``ttl/3``, refreshing ``renewed_t`` and
+  the health snapshot;
+* **expired lease = dead** — a replica that stops renewing (SIGKILL,
+  OOM, wedged host) simply ages out: the router evicts the lease
+  (atomic rename — exactly one evictor wins) and drops the replica
+  from its ring;
+* **drain = release** — graceful shutdown releases the lease at drain
+  START (``POST /drain`` / SIGTERM), so the router stops routing new
+  work to a draining replica while it finishes the accepted work.
+
+The ledger needs a shared filesystem and nothing else — the same
+requirement the AOT bank and the sweep fabric already have, so a
+multi-host fleet is "point ``--fleet-dir`` at the shared mount".
+
+``run_fleet`` is the local coordinator (``python -m raft_tpu.serve
+fleet --replicas N``): optionally warm the shared bank once, spawn N
+replica server subprocesses, wait for their leases, forward SIGTERM.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+from raft_tpu.obs import metrics
+from raft_tpu.parallel import resilience
+from raft_tpu.parallel.fabric import (lease_claim, lease_read,
+                                      lease_remove, lease_rewrite)
+from raft_tpu.utils import config
+from raft_tpu.utils.structlog import log_event
+
+FLEET_DIRNAME = "_fleet"
+
+#: fault kinds targeted at one replica (stripped from the rest by the
+#: coordinator, like the fabric's worker_kill forwarding)
+REPLICA_FAULT_KINDS = ("replica_kill", "replica_hang", "replica_5xx")
+
+
+def fleet_dir(root):
+    return os.path.join(root, FLEET_DIRNAME)
+
+
+def _replicas_dir(root):
+    return os.path.join(fleet_dir(root), "replicas")
+
+
+def _lease_path(root, rid):
+    return os.path.join(_replicas_dir(root), f"{rid}.json")
+
+
+def router_record_path(root):
+    return os.path.join(fleet_dir(root), "router.json")
+
+
+def read_router_record(root):
+    """The router's last published membership record, or None."""
+    try:
+        with open(router_record_path(root)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class FleetLedger:
+    """The replica-membership ledger rooted at ``root`` (usually the
+    serving deploy directory next to the AOT bank).  Replica-side
+    methods (:meth:`claim`/:meth:`renew`/:meth:`release`) are
+    token-guarded like fabric shard leases; observer-side methods
+    (:meth:`replicas`/:meth:`live`/:meth:`expired`/:meth:`evict`) are
+    what the router's membership prober runs."""
+
+    def __init__(self, root, replica_id=None):
+        self.root = root
+        self.replica_id = replica_id
+        self.token = uuid.uuid4().hex
+        # NO mkdir here: read-side users (fleet --status, the router's
+        # prober) must not conjure a ledger tree under a typo'd path —
+        # the write path (claim) creates it
+
+    # ------------------------------------------------------ replica side
+
+    def claim(self, port, host="127.0.0.1", designs=None, buckets=None,
+              healthz=None):
+        """Join the fleet: exclusive lease creation for this replica id.
+        ``designs`` maps served design name -> {"sig": bucket-signature
+        fingerprint, "fingerprint": design content hash} (the router
+        hashes these into its ring keys); ``buckets`` is the distinct
+        signature fingerprint list."""
+        os.makedirs(_replicas_dir(self.root), exist_ok=True)
+        now = time.time()
+        rec = {
+            "replica": self.replica_id,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "addr": str(host),
+            "port": int(port),
+            "claimed_t": now,
+            "renewed_t": now,
+            "ttl_s": float(config.get("FLEET_TTL_S")),
+            "designs": dict(designs or {}),
+            "buckets": list(buckets or ()),
+            "healthz": dict(healthz or {}),
+            "token": self.token,
+        }
+        if not lease_claim(_lease_path(self.root, self.replica_id), rec):
+            return False
+        metrics.counter("fleet_joins").inc()
+        log_event("replica_join", replica=self.replica_id, port=int(port),
+                  designs=sorted(rec["designs"]), root=self.root)
+        return True
+
+    def renew(self, healthz=None):
+        """Refresh ``renewed_t`` (+ the health snapshot); False when
+        the lease is no longer this replica's (evicted or released) —
+        the renewer does NOT re-claim: an evicted replica rejoining
+        must go through the explicit join path."""
+        rec, _ = self.read(self.replica_id)
+        if not rec or rec.get("token") != self.token:
+            return False
+        rec["renewed_t"] = time.time()
+        if healthz is not None:
+            rec["healthz"] = dict(healthz)
+        lease_rewrite(_lease_path(self.root, self.replica_id), rec)
+        return True
+
+    def release(self, reason="drain"):
+        """Leave the fleet (drain start / clean exit).  True when this
+        call removed the lease."""
+        rec, _ = self.read(self.replica_id)
+        if not rec or rec.get("token") != self.token:
+            return False
+        removed = lease_remove(_lease_path(self.root, self.replica_id))
+        if removed:
+            log_event("replica_drain", replica=self.replica_id,
+                      reason=str(reason), root=self.root)
+        return removed
+
+    # ----------------------------------------------------- observer side
+
+    def read(self, rid):
+        return lease_read(_lease_path(self.root, rid))
+
+    def replicas(self):
+        """Every readable lease: ``{replica_id: (record, mtime)}``."""
+        out = {}
+        try:
+            names = os.listdir(_replicas_dir(self.root))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            rec, mtime = lease_read(os.path.join(_replicas_dir(self.root),
+                                                 name))
+            if rec is not None:
+                out[name[:-5]] = (rec, mtime)
+        return out
+
+    @staticmethod
+    def lease_age(rec, mtime, now=None):
+        """Seconds since the lease was last renewed."""
+        now = time.time() if now is None else now
+        return now - float(rec.get("renewed_t") or mtime or now)
+
+    def live(self, now=None):
+        """``{replica_id: record}`` of every lease renewed within its
+        TTL — the router's ring membership source."""
+        now = time.time() if now is None else now
+        out = {}
+        for rid, (rec, mtime) in self.replicas().items():
+            ttl = float(rec.get("ttl_s") or config.get("FLEET_TTL_S"))
+            if self.lease_age(rec, mtime, now) <= ttl:
+                out[rid] = rec
+        return out
+
+    def expired(self, now=None):
+        """``{replica_id: (record, age_s)}`` of leases past their TTL
+        (dead replicas awaiting eviction)."""
+        now = time.time() if now is None else now
+        out = {}
+        for rid, (rec, mtime) in self.replicas().items():
+            ttl = float(rec.get("ttl_s") or config.get("FLEET_TTL_S"))
+            age = self.lease_age(rec, mtime, now)
+            if age > ttl:
+                out[rid] = (rec, age)
+        return out
+
+    def evict(self, rid, reason="expired", age_s=None):
+        """Atomically remove a dead replica's lease (router-side).
+        True when THIS caller won the removal race."""
+        if not lease_remove(_lease_path(self.root, rid)):
+            return False
+        metrics.counter("fleet_evictions").inc()
+        log_event("replica_evict", replica=rid, reason=str(reason),
+                  age_s=round(float(age_s), 3) if age_s is not None
+                  else None, root=self.root)
+        return True
+
+    def summary(self, now=None):
+        """Ledger snapshot for the ``fleet --status`` CLI / tests."""
+        now = time.time() if now is None else now
+        reps = {}
+        for rid, (rec, mtime) in self.replicas().items():
+            ttl = float(rec.get("ttl_s") or config.get("FLEET_TTL_S"))
+            age = self.lease_age(rec, mtime, now)
+            reps[rid] = {
+                "port": rec.get("port"),
+                "pid": rec.get("pid"),
+                "designs": sorted(rec.get("designs") or ()),
+                "age_s": round(age, 3),
+                "live": age <= ttl,
+            }
+        router = read_router_record(self.root)
+        out = {
+            "root": self.root,
+            "replicas": reps,
+            "n_live": sum(1 for r in reps.values() if r["live"]),
+            "router": None,
+        }
+        if router:
+            out["router"] = {
+                "t": router.get("t"),
+                "pid": router.get("pid"),
+                "n_replicas": router.get("n_replicas"),
+                "replicas": sorted(router.get("replicas") or ()),
+            }
+        return out
+
+
+class LeaseRenewer(threading.Thread):
+    """Daemon thread renewing a replica's fleet lease every ``ttl/3``
+    (the fabric ``_Renewer`` pattern).  ``healthz`` is an optional
+    callable returning the snapshot dict to refresh in the lease body
+    — it runs on THIS thread, off the server's event loop."""
+
+    def __init__(self, ledger, healthz=None):
+        super().__init__(name=f"raft-fleet-lease-{ledger.replica_id}",
+                         daemon=True)
+        self.ledger = ledger
+        self.healthz = healthz
+        ttl = float(config.get("FLEET_TTL_S"))
+        self.interval_s = max(ttl / 3.0, 0.05)
+        self._stop_evt = threading.Event()
+
+    def run(self):
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                snap = self.healthz() if self.healthz is not None else None
+                self.ledger.renew(healthz=snap)
+            except Exception:
+                pass  # renewal must never kill the server
+
+    def stop(self):
+        self._stop_evt.set()
+        self.join(timeout=2.0)
+
+
+# ------------------------------------------------------- local coordinator
+
+
+def _strip_replica_faults(env, index):
+    """Forward the replica-targeted fault kinds to exactly ONE spawned
+    replica (``RAFT_TPU_FLEET_FAULT_REPLICA``), stripping them from the
+    rest — every replica arming ``replica_kill`` from a shared
+    environment would kill the whole fleet once each."""
+    fspecs = env.get(config.env_name("FAULTS"), "")
+    if fspecs and index != int(config.get("FLEET_FAULT_REPLICA")):
+        kept = [s for s in fspecs.split(",") if s.strip()
+                and s.strip().split(":")[0] not in REPLICA_FAULT_KINDS]
+        env[config.env_name("FAULTS")] = ",".join(kept)
+    return env
+
+
+def spawn_replica(root, designs_spec, index=0, replica_id=None,
+                  host="127.0.0.1", env=None, extra_args=()):
+    """Spawn one replica server subprocess against the fleet ledger at
+    ``root`` (ephemeral port; the lease carries the real one).
+    stdout/stderr land in ``_fleet/replicas/<rid>.log``.  Returns
+    ``(Popen, replica_id)``."""
+    rid = replica_id or f"r{index}-{uuid.uuid4().hex[:6]}"
+    wenv = dict(os.environ)
+    wenv.update(env or {})
+    _strip_replica_faults(wenv, index)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    old_pp = wenv.get("PYTHONPATH", "")
+    wenv["PYTHONPATH"] = repo + (os.pathsep + old_pp if old_pp else "")
+    os.makedirs(_replicas_dir(root), exist_ok=True)
+    logf = open(os.path.join(_replicas_dir(root), f"{rid}.log"), "ab")
+    argv = [sys.executable, "-m", "raft_tpu.serve"]
+    for spec in designs_spec:
+        argv += ["--designs", spec]
+    argv += ["--host", host, "--port", "0",
+             "--fleet-dir", os.path.abspath(root), "--replica-id", rid]
+    argv += list(extra_args)
+    try:
+        proc = subprocess.Popen(argv, env=wenv, stdout=logf,
+                                stderr=subprocess.STDOUT, cwd=repo)
+    finally:
+        logf.close()  # the child keeps its own handle
+    log_event("fleet_spawn", root=root, replica=rid, pid=proc.pid)
+    return proc, rid
+
+
+def run_fleet(root, replicas, designs_spec, host="127.0.0.1",
+              extra_args=(), warm_bank=False, join_timeout_s=600.0,
+              on_ready=None):
+    """Local fleet coordinator: optionally warm the shared AOT bank
+    ONCE, spawn ``replicas`` server subprocesses, wait for their
+    membership leases, then babysit until SIGTERM/SIGINT (forwarded to
+    every replica, which drains gracefully).  Returns 0 on clean
+    shutdown.
+
+    The one-warmup-for-N-replicas recipe is the whole point of the
+    shared bank: the coordinator pays the trace+compile bill once
+    (``RAFT_TPU_AOT=load``) and every replica then starts under
+    ``RAFT_TPU_AOT=require`` with zero backend compiles — the bank
+    directory is the deploy artifact."""
+    if warm_bank:
+        # in-process warmup through the SAME serve funnel the replicas
+        # dispatch (bucket signature x batch ladder, out_keys default)
+        from raft_tpu.aot.warmup import warmup_model
+
+        paths = [s.split("=", 1)[1] if "=" in s else s
+                 for spec in designs_spec for s in spec.split(",") if s]
+        warmup_model(design=paths[0], kinds=("serve",), designs=paths)
+    ledger = FleetLedger(root)
+    # install the stop signal BEFORE spawning: a SIGTERM during the
+    # join window must drain the replicas already spawned, not orphan
+    # them behind a dead coordinator
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    procs = []
+    try:
+        # append as we go: if spawn k fails, the finally block must
+        # still drain replicas 0..k-1 (a comprehension would discard
+        # them with its exception)
+        for i in range(int(replicas)):
+            procs.append(spawn_replica(root, designs_spec, index=i,
+                                       host=host, extra_args=extra_args))
+        my_rids = {rid for _p, rid in procs}
+        deadline = time.time() + float(join_timeout_s)
+        while not stop.is_set():
+            # only THIS coordinator's replicas count toward readiness —
+            # a predecessor fleet's not-yet-expired leases in the same
+            # --fleet-dir must not fake a ready fleet of dead ports
+            live = ledger.live()
+            if my_rids <= set(live):
+                break
+            dead = [rid for p, rid in procs if p.poll() is not None]
+            if dead:
+                raise RuntimeError(
+                    f"replica(s) {dead} exited before joining the fleet "
+                    f"(see {_replicas_dir(root)}/<rid>.log)")
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"fleet join timed out: "
+                    f"{len(my_rids & set(live))}/{len(procs)} leases "
+                    f"after {join_timeout_s}s")
+            time.sleep(0.25)
+        if not stop.is_set():
+            live = ledger.live()
+            ports = {rid: live[rid].get("port")
+                     for rid in sorted(my_rids) if rid in live}
+            if on_ready is not None:
+                on_ready(ports)
+            stop.wait()
+    finally:
+        # every exit path — clean SIGTERM, join failure, timeout,
+        # KeyboardInterrupt — drains the replicas it spawned
+        rcs = _shutdown_replicas(procs)
+    return 0 if all(rc == 0 for rc in rcs.values()) else 1
+
+
+def _shutdown_replicas(procs):
+    """SIGTERM every live replica, wait out the drain window, SIGKILL
+    stragglers.  Returns {replica_id: returncode}."""
+    for p, _rid in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    rcs = {}
+    drain_s = float(config.get("SERVE_DRAIN_S"))
+    for p, rid in procs:
+        try:
+            rcs[rid] = p.wait(timeout=drain_s + 30.0)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            rcs[rid] = p.wait(timeout=10.0)
+    return rcs
+
+
+def publish_router_record(root, rec):
+    """Atomic write of the router's membership view (``router.json``)
+    — the second `_fleet/` record family, read by ``fleet --status``
+    and the drill assertions."""
+    os.makedirs(fleet_dir(root), exist_ok=True)
+    resilience._atomic_json(router_record_path(root), rec)

@@ -27,16 +27,30 @@ Routes
                     exposition format (the ``RAFT_TPU_METRICS`` file
                     exporter's live HTTP twin)
 ``GET /designs``    registered design names
+``POST /drain``     begin a graceful drain (202) — loopback peers only
+                    (the fleet router drains a replica it is evicting;
+                    a tenant must never be able to drain the service)
 
-Shutdown: SIGTERM/SIGINT triggers a graceful drain — stop accepting,
-finish in-flight ticks (every accepted request gets its response),
-flush metrics (``RAFT_TPU_METRICS`` path when set), then exit.
+Shutdown: SIGTERM/SIGINT (or ``POST /drain``) triggers a graceful
+drain — release the fleet membership lease FIRST (``on_drain_start``,
+so the router stops routing new work here while accepted work
+finishes), stop accepting, finish in-flight ticks (every accepted
+request gets its response), flush metrics (``RAFT_TPU_METRICS`` path
+when set), then exit.
+
+Fault injection (:mod:`raft_tpu.utils.faults`): the three
+``replica_*`` kinds consult the ``serve_evaluate`` site here —
+``replica_kill`` SIGKILLs the process on the next /evaluate,
+``replica_hang`` parks it past every timeout, ``replica_5xx`` returns
+a 500 — driving the router's kill-a-replica / breaker drills
+deterministically.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import os
 import signal
 import time
 
@@ -46,18 +60,15 @@ from raft_tpu.obs import metrics
 from raft_tpu.obs.spans import (current_ids, format_traceparent,
                                 parse_traceparent, span)
 from raft_tpu.serve import batcher as batcher_mod
-from raft_tpu.utils import config
+from raft_tpu.serve import wire
+from raft_tpu.utils import config, faults
 from raft_tpu.utils.structlog import log_event
 
 _T0 = time.perf_counter()
 
-_STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                405: "Method Not Allowed", 408: "Request Timeout",
-                413: "Payload Too Large", 422: "Unprocessable Entity",
-                429: "Too Many Requests", 500: "Internal Server Error",
-                503: "Service Unavailable"}
-
-MAX_BODY_BYTES = 8 * 1024 * 1024
+#: kept as module aliases — the wire module is the single definition
+#: shared with the fleet router
+MAX_BODY_BYTES = wire.MAX_BODY_BYTES
 
 
 def _json_value(v):
@@ -85,11 +96,18 @@ def encode_result(result):
 class Server:
     """One service instance: batcher + asyncio HTTP endpoint."""
 
-    def __init__(self, batcher, host="127.0.0.1", port=8787):
+    def __init__(self, batcher, host="127.0.0.1", port=8787,
+                 on_drain_start=None):
         self.batcher = batcher
         self.host = host
         self.port = int(port)
         self.timeout_s = float(config.get("SERVE_TIMEOUT_S"))
+        #: called (in an executor — it does file IO) at the very START
+        #: of the graceful drain, before any in-flight work finishes:
+        #: the fleet replica releases its membership lease here, so the
+        #: router stops routing new requests to a draining replica
+        #: while it completes the accepted ones
+        self.on_drain_start = on_drain_start
         self._server = None
         self._stop = None
         self._handlers = set()
@@ -227,17 +245,45 @@ class Server:
             **snap,
         }
 
-    async def _route(self, method, path, body, client, headers):
+    async def _route(self, method, path, body, client, headers,
+                     peer_host="?"):
         """Returns ``(status, payload)`` or ``(status, payload,
         extra_response_headers)``."""
         if path == "/evaluate":
             if method != "POST":
                 return 405, {"ok": False, "error": "POST required"}
+            # deterministic replica failure modes for the fleet drills
+            # (raft_tpu.utils.faults): kill = SIGKILL mid-load, hang =
+            # park past every timeout (wedged-but-alive), 5xx = error
+            # response — the router must retry/break around all three
+            if faults.take("replica_kill", "serve_evaluate"):
+                os.kill(os.getpid(), signal.SIGKILL)
+            if faults.take("replica_hang", "serve_evaluate"):
+                await asyncio.sleep(2 * self.timeout_s)
+                return 503, {"ok": False, "error": "hang fault elapsed"}
+            if faults.take("replica_5xx", "serve_evaluate"):
+                return 500, {"ok": False, "error": "injected 5xx fault"}
             if self.batcher.draining:
                 return 503, {"ok": False, "error": "service is draining",
                              "reason": "draining"}
             return await self._evaluate(body, client,
                                         traceparent=headers.get("traceparent"))
+        if path == "/drain":
+            if method != "POST":
+                return 405, {"ok": False, "error": "POST required"}
+            # admin-gated: only loopback peers (the operator or a
+            # co-hosted router evicting this replica) may drain
+            if peer_host not in wire.LOOPBACK_HOSTS:
+                return 403, {"ok": False,
+                             "error": "drain is loopback-only"}
+            if self._stop is None:
+                return 503, {"ok": False,
+                             "error": "server not accepting signals yet"}
+            already = self.batcher.draining or self._stop.is_set()
+            self._stop.set()  # same path as SIGTERM: shutdown() runs
+            #                   after this response is written
+            return 202, {"ok": True, "draining": True,
+                         "already_draining": bool(already)}
         if method != "GET":
             return 405, {"ok": False, "error": "GET required"}
         if path == "/healthz":
@@ -250,47 +296,16 @@ class Server:
 
     # -------------------------------------------------------- connection
 
+    # request parsing + response formatting live in raft_tpu.serve.wire
+    # (shared with the fleet router)
+
     async def _read_request(self, reader):
-        """One HTTP request off the stream: (method, path, headers,
-        body), or None on clean EOF."""
-        line = await reader.readline()
-        if not line:
-            return None
-        parts = line.decode("latin-1").strip().split()
-        if len(parts) < 2:
-            raise ValueError(f"bad request line {line!r}")
-        method, path = parts[0].upper(), parts[1].split("?", 1)[0]
-        headers = {}
-        while True:
-            h = await reader.readline()
-            if h in (b"\r\n", b"\n", b""):
-                break
-            name, _, value = h.decode("latin-1").partition(":")
-            headers[name.strip().lower()] = value.strip()
-        n = int(headers.get("content-length", 0) or 0)
-        if n > MAX_BODY_BYTES:
-            raise ValueError(f"body of {n} bytes exceeds {MAX_BODY_BYTES}")
-        body = await reader.readexactly(n) if n else b""
-        return method, path, headers, body
+        return await wire.read_request(reader)
 
     @staticmethod
     def _response_bytes(status, payload, keep_alive, extra_headers=None):
-        if isinstance(payload, (dict, list)):
-            data = json.dumps(payload).encode()
-            ctype = "application/json"
-        else:
-            data = str(payload).encode()
-            ctype = "text/plain; version=0.0.4"
-        head = [f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, 'Unknown')}",
-                f"Content-Type: {ctype}",
-                f"Content-Length: {len(data)}",
-                f"Connection: {'keep-alive' if keep_alive else 'close'}"]
-        for name, value in (extra_headers or {}).items():
-            head.append(f"{name}: {value}")
-        if status == 429 and isinstance(payload, dict):
-            head.append(
-                f"Retry-After: {max(1, int(payload.get('retry_after_s') or 0) + 1)}")
-        return ("\r\n".join(head) + "\r\n\r\n").encode() + data
+        return wire.response_bytes(status, payload, keep_alive,
+                                   extra_headers)
 
     async def _handle(self, reader, writer):
         task = asyncio.current_task()
@@ -314,14 +329,16 @@ class Server:
                 extra = None
                 try:
                     routed = await self._route(method, path, body,
-                                               client, headers)
+                                               client, headers,
+                                               peer_host=peer_host)
                     status, payload = routed[0], routed[1]
                     extra = routed[2] if len(routed) > 2 else None
                 except Exception as e:  # noqa: BLE001 — keep serving
                     status, payload = 500, {"ok": False,
                                             "error": repr(e)[:300]}
                 keep = (headers.get("connection", "keep-alive").lower()
-                        != "close") and not self.batcher.draining
+                        != "close") and not self.batcher.draining \
+                    and not (self._stop is not None and self._stop.is_set())
                 writer.write(self._response_bytes(status, payload, keep,
                                                   extra))
                 await writer.drain()
@@ -371,6 +388,16 @@ class Server:
         flush metrics."""
         t0 = time.perf_counter()
         loop = asyncio.get_running_loop()
+        # 0. release fleet membership FIRST (file IO — executor): the
+        #    router must stop routing NEW work here before we spend the
+        #    drain window finishing the accepted work; a lease released
+        #    at process exit instead would keep attracting traffic for
+        #    the whole drain
+        if self.on_drain_start is not None:
+            try:
+                await loop.run_in_executor(None, self.on_drain_start)
+            except Exception as e:  # noqa: BLE001 — drain must proceed
+                log_event("serve_error", error=repr(e)[:300], rows=0)
         # 1. stop accepting new connections; mark draining so keep-alive
         #    connections get 503 for new requests
         self._server.close()
@@ -409,10 +436,13 @@ class Server:
                   wall_s=round(time.perf_counter() - t0, 3))
 
 
-async def run_server(batcher, host="127.0.0.1", port=8787, ready=None):
+async def run_server(batcher, host="127.0.0.1", port=8787, ready=None,
+                     on_drain_start=None):
     """Start + block until signalled.  ``ready(server)`` runs after the
-    socket binds (the CLI prints its ready line there)."""
-    server = await Server(batcher, host, port).start()
+    socket binds (the CLI prints its ready line there; the fleet
+    replica claims its membership lease there too)."""
+    server = await Server(batcher, host, port,
+                          on_drain_start=on_drain_start).start()
     if ready is not None:
         ready(server)
     await server.serve_until_stopped()

@@ -349,6 +349,66 @@ _register(
          help="sliding-window length (seconds) of the serve latency "
               "time-series: /healthz p50/p95/rate are computed over "
               "the last this-many seconds, not process lifetime"),
+    Flag("SERVE_CLIENT_RETRIES", "int", 0,
+         help="serve-client retry budget for clean 429/503 rejections "
+              "(capped exponential backoff honoring Retry-After; 0 "
+              "disables — rejections return to the caller as-is)"),
+    # -- serving fleet: replica membership ledger (see raft_tpu.serve.
+    #    fleet and README "Serving fleet")
+    Flag("FLEET_DIR", "str", "",
+         help="default fleet deploy directory (the _fleet/ membership "
+              "ledger root) for `python -m raft_tpu.serve "
+              "{--fleet-dir,fleet,router}` when the flag is not passed "
+              "explicitly"),
+    Flag("FLEET_TTL_S", "float", 10.0,
+         help="replica membership-lease time-to-live: a lease not "
+              "renewed within this window is a dead replica — the "
+              "router evicts it from the hash ring (renewals run every "
+              "ttl/3 from a daemon thread)"),
+    Flag("FLEET_FAULT_REPLICA", "int", 0,
+         help="index of the ONE spawned fleet replica that receives "
+              "the replica-targeted RAFT_TPU_FAULTS kinds "
+              "(replica_kill, replica_hang, replica_5xx); other "
+              "replicas get them stripped so the kill-a-replica drill "
+              "is deterministic"),
+    # -- serving fleet: failover router (see raft_tpu.serve.router)
+    Flag("ROUTER_PROBE_S", "float", 1.0,
+         help="router membership-prober period: lease-ledger scan, "
+              "joiner /healthz admission probe, expired-lease "
+              "eviction, breaker-open recovery probe, router.json "
+              "publication"),
+    Flag("ROUTER_VNODES", "int", 64,
+         help="virtual nodes per replica on the consistent-hash ring "
+              "(more = smoother key distribution, larger ring)"),
+    Flag("ROUTER_RETRIES", "int", 3,
+         help="failover retry budget per proxied request: a connect "
+              "failure, dropped response, per-attempt timeout or "
+              "retryable 5xx moves the request to the next ring "
+              "replica up to this many extra attempts"),
+    Flag("ROUTER_BACKOFF_MS", "float", 50.0,
+         help="base delay of the router's capped exponential failover "
+              "backoff (doubles per retry; shared schedule with the "
+              "serve client's 429/503 retries)"),
+    Flag("ROUTER_BACKOFF_CAP_MS", "float", 2000.0,
+         help="upper bound of the router failover backoff (an "
+              "upstream Retry-After may exceed it — the server's "
+              "window wins)"),
+    Flag("ROUTER_TIMEOUT_S", "float", 300.0,
+         help="per-attempt upstream timeout of one proxied request "
+              "(connect + response); a timed-out attempt counts "
+              "against the replica's breaker and fails over"),
+    Flag("ROUTER_BREAKER_FAILS", "int", 3,
+         help="consecutive upstream failures that open a replica's "
+              "circuit breaker (no traffic until half-open)"),
+    Flag("ROUTER_BREAKER_COOLDOWN_S", "float", 5.0,
+         help="open-breaker cooldown before ONE half-open trial "
+              "request (or prober /healthz success) may close it"),
+    Flag("ROUTER_HEDGE_MS", "float", 0.0,
+         help="hedged-request delay for p99 stragglers: a first "
+              "attempt still unanswered after this many ms fires a "
+              "second copy at the next ring replica and the first "
+              "good response wins (0 disables; duplicate dispatch is "
+              "benign — content-addressed result caches)"),
     # -- multi-host distributed runtime (dryrun-tested on CPU; wired
     #    into resilience.resolve_mesh for real pods)
     Flag("DIST", "bool", False,

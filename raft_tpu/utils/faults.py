@@ -42,13 +42,29 @@ lease_expire lease_renew      fabric worker silently stops renewing its
                               leases (simulates a wedged-but-alive
                               process; stragglers get stolen while the
                               worker keeps computing)
+replica_kill serve_evaluate   serving replica SIGKILLs itself on the
+                              next /evaluate it routes (simulates a
+                              replica dying mid-load; its fleet lease
+                              expires, the router retries in-flight
+                              requests onto the next ring replica and
+                              evicts it)
+replica_hang serve_evaluate   serving replica parks the next /evaluate
+                              past every timeout (wedged-but-alive: the
+                              router's per-attempt timeout fires and
+                              fails the request over)
+replica_5xx  serve_evaluate   serving replica answers the next
+                              /evaluate with HTTP 500 (the retryable
+                              failure class that drives the router's
+                              circuit breaker without killing anything)
 ========== ================== ==============================================
 
 The two worker-targeted kinds (``worker_kill``, ``lease_expire``) are
 forwarded by the fabric coordinator to exactly ONE spawned worker
 (index ``RAFT_TPU_FABRIC_FAULT_WORKER``, default 0) and stripped from
 the rest — every worker arming ``worker_kill:worker_shard:1`` from a
-shared environment would kill the whole fleet once each.
+shared environment would kill the whole fleet once each.  The three
+replica-targeted kinds (``replica_*``) get the same treatment from the
+fleet coordinator (``RAFT_TPU_FLEET_FAULT_REPLICA``).
 
 Example::
 
