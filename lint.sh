@@ -80,6 +80,25 @@ python -m raft_tpu.obs trace --merge tests/fixtures/obs \
 python -m raft_tpu.obs trace --merge tests/fixtures/obs_router \
     -o /tmp/raft_obs_router_merge_check.json --check > /dev/null
 
+# alert-rule engine: the default rule pack (+ any RAFT_TPU_ALERT_RULES
+# override) must validate, the clean run-record fixture must replay
+# with no rule firing (exit 0), and the seeded alerting fixture (SLO
+# breaches + breaker storm + canary parity split) must be caught with
+# EXACTLY exit 1 — the `obs alerts eval --record` CI contract needs no
+# live fleet and no jax import
+python -m raft_tpu.obs alerts check > /dev/null
+python -m raft_tpu.obs alerts eval --record tests/fixtures/runs/clean.json \
+    > /dev/null
+alerts_rc=0
+python -m raft_tpu.obs alerts eval \
+    --record tests/fixtures/runs/alerting.json > /dev/null 2>&1 \
+    || alerts_rc=$?
+if [ "$alerts_rc" -ne 1 ]; then
+    echo "lint.sh: obs alerts eval exited $alerts_rc on the alerting" \
+         "fixture (want 1: rules fired)" >&2
+    exit 1
+fi
+
 # perf-regression sentinel: against the checked-in baseline record,
 # the clean fixture run must PASS (exit 0) and the regressed fixture
 # (5x shard wall, dropped throughput, doubled padding waste) must be

@@ -94,6 +94,7 @@ SHARED_STATE_MODULES = (
     "raft_tpu/obs/runs.py",
     "raft_tpu/obs/metrics.py",
     "raft_tpu/obs/heartbeat.py",
+    "raft_tpu/obs/alerts.py",
     "raft_tpu/aot/bank.py",
     "raft_tpu/serve/",
     "raft_tpu/utils/structlog.py",

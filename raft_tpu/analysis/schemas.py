@@ -98,6 +98,8 @@ _OBS_CLI = "raft_tpu/obs/__main__.py"
 _BANK = "raft_tpu/aot/bank.py"
 _FLEET = "raft_tpu/serve/fleet.py"
 _ROUTER = "raft_tpu/serve/router.py"
+_ALERTS = "raft_tpu/obs/alerts.py"
+_CANARY = "raft_tpu/serve/canary.py"
 
 FAMILIES: tuple[Family, ...] = (
     Family(
@@ -181,6 +183,22 @@ FAMILIES: tuple[Family, ...] = (
         "ring replicas + breaker states, advisory)",
         writers=(Site(_ROUTER, "RouterState.membership_record", "rec"),),
         readers=(Site(_FLEET, "FleetLedger.summary", "router"),)),
+    Family(
+        "alert-record",
+        "alert fire/resolve transition record (the RAFT_TPU_ALERTS "
+        "JSONL sink + the alert_fire/alert_resolve event payload — "
+        "raft_tpu.obs.alerts)",
+        writers=(Site(_ALERTS, "AlertEngine._record", None),),
+        readers=(Site(_ALERTS, "read_sink", "rec"),
+                 Site(_ALERTS, "render_sink_summary", "rec"))),
+    Family(
+        "canary-golden",
+        "content-addressed golden row of the serving canary (design "
+        "content hash + exact case bits + out_keys -> outputs + int32 "
+        "status — raft_tpu.serve.canary)",
+        writers=(Site(_CANARY, "CanaryState.capture", "rec"),),
+        readers=(Site(_CANARY, "CanaryState.compare", "golden"),
+                 Site(_CANARY, "CanaryState.observe", "golden"))),
     Family(
         "aot-sidecar", "AOT bank entry .json metadata sidecar",
         writers=(Site(_BANK, "entry_key", "meta"),

@@ -19,7 +19,12 @@ turns that stream into first-class telemetry:
 * :mod:`raft_tpu.obs.events` — the lint-enforced registry of every
   event name (``event-name`` rule);
 * :mod:`raft_tpu.obs.report` — ``python -m raft_tpu.obs report`` and
-  ``... trace`` (Chrome/Perfetto export) over captured JSONL.
+  ``... trace`` (Chrome/Perfetto export) over captured JSONL;
+* :mod:`raft_tpu.obs.alerts` — the ACTIVE layer: declarative alert
+  rules over the registry (``RAFT_TPU_ALERT_EVAL_S`` daemon,
+  ``alert_fire``/``alert_resolve``, the ``RAFT_TPU_ALERTS`` sink,
+  ``GET /alerts``, ``python -m raft_tpu.obs alerts``) plus the
+  ``x-raft-provenance`` codec the serving canary cross-checks.
 
 All instrumentation is host-side only: nothing here runs under a jax
 trace, the jaxpr primitive baseline is unchanged, and with

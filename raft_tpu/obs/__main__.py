@@ -7,6 +7,7 @@
     python -m raft_tpu.obs events
     python -m raft_tpu.obs spans
     python -m raft_tpu.obs runs   {record,list,compare,regress,ingest,pin}
+    python -m raft_tpu.obs alerts {list,check,eval}
 
 ``report`` prints the per-stage wall-time tree, counter table, program
 cost ledger, serve tail-attribution and padding-waste tables and the
@@ -21,6 +22,14 @@ server onto ONE wall-clock timeline using the per-process
 when the merged capture has unmatched span begins or orphan spans (a
 parent id resolving to no span) — the cross-process propagation
 acceptance gate.  ``events``/``spans`` list the registered schemas.
+
+``alerts`` is the live fleet-health layer's offline face
+(:mod:`raft_tpu.obs.alerts`): ``list`` prints the effective rule pack
+(default + ``RAFT_TPU_ALERT_RULES``/``--rules``, optionally
+summarizing a ``RAFT_TPU_ALERTS`` sink), ``check`` validates it (the
+lint.sh gate), and ``eval --record`` replays the rules against a
+stored run record — rate rules gate on their cumulative totals — so
+CI can gate alerting with no live fleet and no jax import.
 
 ``runs`` is the longitudinal perf store (:mod:`raft_tpu.obs.runs`,
 ``RAFT_TPU_RUNS_DIR``): ``record`` appends a run record from the
@@ -119,6 +128,86 @@ def _cmd_spans(_args):
 
     for name, help_ in ev.describe_spans():
         print(f"{name:32s} {help_}")
+    return 0
+
+
+# ----------------------------------------------------------- alerts verbs
+
+
+def _alert_rules(args):
+    from raft_tpu.obs import alerts
+    from raft_tpu.utils import config
+
+    path = getattr(args, "rules", None) or config.get("ALERT_RULES") or None
+    return alerts.load_rules(path), path
+
+
+def _cmd_alerts_list(args):
+    from raft_tpu.obs import alerts
+
+    try:
+        rules, path = _alert_rules(args)
+    except (OSError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    print(f"{len(rules)} rule(s)"
+          + (f" (default pack + {path})" if path else " (default pack)"))
+    print(f"  {'name':24s} {'severity':10s} {'predicate':12s} "
+          f"{'threshold':>10s} {'for_s':>7s} {'clear_s':>7s}  metric")
+    for r in sorted(rules, key=lambda r: r.name):
+        print(f"  {r.name:24s} {r.severity:10s} {r.predicate:12s} "
+              f"{r.threshold:10.4g} {r.for_s:7.1f} {r.clear_s:7.1f}  "
+              f"{r.metric}")
+    if args.sink:
+        try:
+            records, bad = alerts.read_sink(args.sink)
+        except OSError as e:
+            print(f"cannot read sink {args.sink}: {e}", file=sys.stderr)
+            return 2
+        print(f"\nsink {args.sink}: {len(records)} record(s)"
+              + (f" ({bad} unparseable)" if bad else ""))
+        for line in alerts.render_sink_summary(records):
+            print(f"  {line}")
+    return 0
+
+
+def _cmd_alerts_check(args):
+    """Rule-pack validation (the lint.sh gate): the default pack plus
+    the given/flagged rule file must parse and validate.  Exit 0 ok,
+    1 invalid."""
+    try:
+        rules, path = _alert_rules(args)
+    except (OSError, ValueError) as e:
+        print(f"alerts check FAILED: {e}", file=sys.stderr)
+        return 1
+    print(f"ok: {len(rules)} rule(s) valid"
+          + (f" (default pack + {path})" if path else " (default pack)"))
+    return 0
+
+
+def _cmd_alerts_eval(args):
+    """Replay the rule pack against a stored run record — no live
+    fleet, no jax import.  Exit 0 clean, 1 when any rule fires."""
+    from raft_tpu.obs import alerts, runs
+
+    try:
+        rules, _path = _alert_rules(args)
+        record = runs.load_record(args.record)
+    except (OSError, ValueError) as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    fired, checked = alerts.replay_rules(rules, record)
+    name = os.path.basename(args.record)
+    print(f"alerts eval {name}: {checked}/{len(rules)} rule(s) "
+          "applicable")
+    for f in fired:
+        print(f"  FIRED: {f['rule']} [{f['severity']}] {f['metric']} = "
+              f"{f['value']:.6g} (replay threshold {f['threshold']:.6g})"
+              + (f" — {f['help']}" if f.get("help") else ""))
+    if fired:
+        print(f"  FAILED: {len(fired)} rule(s) fired", file=sys.stderr)
+        return 1
+    print("  ok: no rules fired")
     return 0
 
 
@@ -355,6 +444,34 @@ def main(argv=None):
     sub.add_parser("events", help="list the registered event schema")
     sub.add_parser("spans", help="list the registered span names")
 
+    p = sub.add_parser("alerts",
+                       help="alert-rule engine: list/check the rule "
+                            "pack, replay it against stored run "
+                            "records (raft_tpu.obs.alerts)")
+    asub = p.add_subparsers(dest="alerts_cmd", required=True)
+
+    a = asub.add_parser("list", help="print the effective rule pack "
+                                     "(default + RAFT_TPU_ALERT_RULES/"
+                                     "--rules)")
+    a.add_argument("--rules", default=None,
+                   help="YAML/JSON rule file over the default pack")
+    a.add_argument("--sink", default=None,
+                   help="also summarize a RAFT_TPU_ALERTS JSONL sink")
+
+    a = asub.add_parser("check", help="validate the rule pack "
+                                      "(exit 1 on an invalid rule — "
+                                      "the lint.sh gate)")
+    a.add_argument("--rules", default=None)
+
+    a = asub.add_parser(
+        "eval",
+        help="replay the rule pack against a stored run record (no "
+             "jax, no live fleet; exit 1 when any rule fires)")
+    a.add_argument("--record", required=True,
+                   help="a run-record .json from the RAFT_TPU_RUNS_DIR "
+                        "store (or a checked-in fixture)")
+    a.add_argument("--rules", default=None)
+
     p = sub.add_parser("runs",
                        help="longitudinal run-record store + regression "
                             "sentinel (RAFT_TPU_RUNS_DIR)")
@@ -409,6 +526,9 @@ def main(argv=None):
                 "compare": _cmd_runs_compare, "regress": _cmd_runs_regress,
                 "ingest": _cmd_runs_ingest,
                 "pin": _cmd_runs_pin}[args.runs_cmd](args)
+    if args.cmd == "alerts":
+        return {"list": _cmd_alerts_list, "check": _cmd_alerts_check,
+                "eval": _cmd_alerts_eval}[args.alerts_cmd](args)
     return {"report": _cmd_report, "trace": _cmd_trace,
             "events": _cmd_events, "spans": _cmd_spans}[args.cmd](args)
 

@@ -253,7 +253,8 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         "the membership prober reconciled the hash ring against the "
         "lease ledger (join/drain/evict — zero router restarts)"),
     "router_request": (
-        ("replica", "code", "attempts", "hedged", "design", "wall_s"),
+        ("replica", "code", "attempts", "hedged", "design", "wall_s",
+         "provenance?"),
         "one proxied /evaluate resolved: which replica answered, the "
         "final HTTP code, and how many failover attempts it took "
         "(replica=None on a 503 rejection)"),
@@ -282,6 +283,34 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         "a half-open trial (live request, or probe=true for the "
         "prober's /healthz recovery check) succeeded and the "
         "replica's breaker closed"),
+    # --------------------------------------------- live fleet health
+    "alert_fire": (
+        ("rule", "severity", "metric", "value", "threshold", "context"),
+        "one alert rule's condition held past its for-duration and the "
+        "alert FIRED (raft_tpu.obs.alerts; also appended to the "
+        "RAFT_TPU_ALERTS JSONL sink and counted in alerts_active/"
+        "alerts_fired); context carries the publishing subsystem's "
+        "detail payload — the canary names the offending provenance "
+        "here"),
+    "alert_resolve": (
+        ("rule", "severity", "metric", "duration_s", "value"),
+        "a firing alert's condition stayed clean past its clear_s "
+        "hysteresis and the alert RESOLVED (duration_s = how long it "
+        "fired)"),
+    "canary_golden": (
+        ("design", "key", "status", "replica"),
+        "one content-addressed golden row captured (design content "
+        "hash + exact canary case bits + out_keys -> outputs + int32 "
+        "status — raft_tpu.serve.canary); replica names the source of "
+        "a router-side capture, None for a replica's own warmup "
+        "capture"),
+    "canary_check": (
+        ("design", "replica", "ok", "reason", "provenance_ok", "key"),
+        "one canary probe compared against its golden: ok=false means "
+        "numeric/status drift vs the golden OR a cross-replica "
+        "provenance split (stale bank, env skew, flag divergence) — "
+        "feeds canary_pass/canary_fail and the canary-parity alert "
+        "rule"),
     # --------------------------------------------- run-record store
     "run_record": (
         ("kind", "path", "label?"),

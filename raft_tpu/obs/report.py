@@ -391,6 +391,7 @@ def report_data(events, n_bad=0, source="<events>"):
                             "replica_drain", "replica_evict",
                             "router_ring_update")
     router_counts = dict.fromkeys(_ROUTER_COUNT_EVENTS, 0)
+    prov_by_design = {}
     for e in events:
         if e["event"] in router_counts:
             router_counts[e["event"]] += 1
@@ -404,6 +405,13 @@ def report_data(events, n_bad=0, source="<events>"):
         rec["attempts"] += int(e.get("attempts") or 1)
         if e.get("hedged"):
             rec["hedged"] += 1
+        # per-replica provenance stamps (x-raft-provenance forwarded by
+        # the router): the consistency line below checks that replicas
+        # serving the SAME design agree on bank sha + code hash
+        if e.get("provenance") and e.get("replica"):
+            prov_by_design.setdefault(
+                str(e.get("design") or "?"), {})[
+                str(e["replica"])] = e["provenance"]
     router_rows = [
         {"replica": rid, "code": code, "requests": len(rec["walls"]),
          "attempts": rec["attempts"], "hedged": rec["hedged"],
@@ -411,9 +419,58 @@ def report_data(events, n_bad=0, source="<events>"):
          "p95_s": _percentile(rec["walls"], 0.95),
          "max_s": max(rec["walls"])}
         for (rid, code), rec in sorted(routed.items())]
+    provenance = None
+    if prov_by_design:
+        from raft_tpu.obs.alerts import (parse_provenance,
+                                         provenance_consistency)
+
+        parsed = {d: {rid: parse_provenance(p) for rid, p in m.items()}
+                  for d, m in prov_by_design.items()}
+        provenance = provenance_consistency(parsed)
+        provenance["replicas"] = sorted(
+            {rid for m in parsed.values() for rid in m})
     router_summary = None
     if router_rows or any(router_counts.values()):
-        router_summary = {"replicas": router_rows, **router_counts}
+        router_summary = {"replicas": router_rows,
+                          "provenance": provenance, **router_counts}
+
+    # alerting + canary section: alert_fire/alert_resolve lifecycles
+    # and canary probe outcomes from the capture (the active layer
+    # PR 14 added over these signals)
+    alert_rules: dict = {}
+    canary_checks = []
+    canary_goldens = 0
+    for e in events:
+        if e["event"] == "alert_fire":
+            r = alert_rules.setdefault(
+                str(e.get("rule") or "?"),
+                {"severity": e.get("severity"), "fires": 0, "resolves": 0})
+            r["fires"] += 1
+        elif e["event"] == "alert_resolve":
+            r = alert_rules.setdefault(
+                str(e.get("rule") or "?"),
+                {"severity": e.get("severity"), "fires": 0, "resolves": 0})
+            r["resolves"] += 1
+        elif e["event"] == "canary_check":
+            canary_checks.append(e)
+        elif e["event"] == "canary_golden":
+            canary_goldens += 1
+    alerts_summary = None
+    if alert_rules or canary_checks or canary_goldens:
+        alerts_summary = {
+            "rules": {n: dict(r) for n, r in sorted(alert_rules.items())},
+            "active_at_end": sorted(
+                n for n, r in alert_rules.items()
+                if r["fires"] > r["resolves"]),
+            "canary": ({
+                "goldens": canary_goldens,
+                "checks": len(canary_checks),
+                "failed": sum(1 for e in canary_checks if not e.get("ok")),
+                "provenance_failures": sum(
+                    1 for e in canary_checks
+                    if e.get("provenance_ok") is False),
+            } if (canary_checks or canary_goldens) else None),
+        }
 
     ticks = [e for e in events if e["event"] == "serve_tick"]
     tick_summary = None
@@ -521,6 +578,7 @@ def report_data(events, n_bad=0, source="<events>"):
         "serve": ({"endpoints": endpoint_rows, "ticks": tick_summary}
                   if endpoint_rows or ticks else None),
         "router": router_summary,
+        "alerts": alerts_summary,
         "serve_stages": serve_stage_attribution(events),
         "cost_ledger": ({"occupancy": occupancy, "programs": ledger_rows}
                         if ledger_rows else None),
@@ -651,6 +709,37 @@ def render_report(events, n_bad=0, source="<events>"):
             f"{router['replica_drain']} drains / "
             f"{router['replica_evict']} evictions "
             f"({router['router_ring_update']} ring updates)")
+        prov = router.get("provenance")
+        if prov:
+            if prov["consistent"]:
+                out.append(
+                    "  provenance: consistent — bank sha + code hash "
+                    f"agree across {len(prov['replicas'])} replica(s)")
+            else:
+                out.append("  provenance: INCONSISTENT —")
+                for s in prov["splits"]:
+                    out.append(
+                        f"    design {s['design']}: {s['field']} "
+                        + "  ".join(f"{rid}={v}"
+                                    for rid, v in s["values"].items()))
+
+    alerts_summary = data["alerts"]
+    if alerts_summary:
+        out.append("")
+        out.append("alerts & canaries (rule / severity / fires / "
+                   "resolves)")
+        for name, r in alerts_summary["rules"].items():
+            out.append(f"  {name:32s} {str(r.get('severity') or '?'):10s} "
+                       f"{r['fires']:6d} {r['resolves']:8d}")
+        if alerts_summary["active_at_end"]:
+            out.append("  STILL FIRING at capture end: "
+                       + ", ".join(alerts_summary["active_at_end"]))
+        c = alerts_summary["canary"]
+        if c:
+            out.append(
+                f"  canary: {c['goldens']} golden(s), {c['checks']} "
+                f"check(s), {c['failed']} failed "
+                f"({c['provenance_failures']} provenance split(s))")
 
     attrib = data["serve_stages"]
     if attrib:

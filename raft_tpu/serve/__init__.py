@@ -49,6 +49,11 @@ new dependencies):
              signature, design content hash), retry-with-backoff onto
              the next replica, per-replica circuit breakers, hedged
              requests, 503 + Retry-After only when nobody can answer
+``canary``   golden-answer canary prober: content-addressed golden
+             rows per design, low-rate probes pinned per replica,
+             bit-for-status / tolerance-for-floats comparison and the
+             cross-replica provenance consistency check feeding the
+             canary_parity alert (see raft_tpu.obs.alerts)
 
 Start a server::
 

@@ -146,6 +146,32 @@ def _serve_main(argv):
               f"({loaded} bank-loaded, {compiled} compiled) in {wall:.1f}s",
               flush=True)
 
+    # the replica id is fixed BEFORE the server starts: the provenance
+    # stamp and the fleet lease must name the same replica
+    rid = args.replica_id or f"replica-{uuid.uuid4().hex[:8]}"
+    # provenance stamps (x-raft-provenance on every /evaluate
+    # response): bank key + sidecar sha per design, code hash, flags
+    # key, replica id — computed once here, a dict lookup per request
+    provenance = engine.build_provenance(
+        registry, mesh=batcher.mesh, out_keys=batcher.out_keys,
+        sizes=batcher.sizes, replica_id=rid)
+    if float(config.get("CANARY_S") or 0) > 0:
+        # golden capture at warmup: one banked dispatch per design at
+        # the canary case (programs are already warm) — the replica's
+        # own golden rows, reported at GET /alerts
+        from raft_tpu.serve import canary as canary_mod
+
+        state = canary_mod.capture_goldens(
+            [registry.get(n) for n in registry.names()],
+            mesh=batcher.mesh, out_keys=batcher.out_keys)
+        print(f"canary: captured {state.summary()['goldens']} golden "
+              "row(s)", flush=True)
+    # in-process alert evaluator (RAFT_TPU_ALERT_EVAL_S > 0; served at
+    # GET /alerts) — no flag, no thread
+    from raft_tpu.obs import alerts as alerts_mod
+
+    alerts_mod.maybe_start()
+
     fleet_root = _default_fleet_dir(args.fleet_dir)
     fleet_state = {}
 
@@ -158,7 +184,7 @@ def _serve_main(argv):
             return
         # join the fleet only AFTER warmup + bind: the router must
         # never route to a replica that would trace on the request
-        rid = args.replica_id or f"replica-{uuid.uuid4().hex[:8]}"
+        # (rid was fixed above, shared with the provenance stamp)
         ledger = fleet_mod.FleetLedger(fleet_root, replica_id=rid)
         meta = {}
         for name in registry.names():
@@ -173,8 +199,10 @@ def _serve_main(argv):
                     "cache": s["cache"]}
 
         buckets = sorted({m["sig"] for m in meta.values()})
+        served_keys = list(batcher.out_keys)
         if not ledger.claim(server.port, host=server.host, designs=meta,
-                            buckets=buckets, healthz=healthz()):
+                            buckets=buckets, healthz=healthz(),
+                            out_keys=served_keys):
             # a lease already exists under this forced id.  Only a
             # crashed predecessor's EXPIRED lease may be evicted — a
             # live one means another replica is serving under this id
@@ -190,7 +218,8 @@ def _serve_main(argv):
                 ledger.evict(rid, reason="stale_self", age_s=age)
                 if not ledger.claim(server.port, host=server.host,
                                     designs=meta, buckets=buckets,
-                                    healthz=healthz()):
+                                    healthz=healthz(),
+                                    out_keys=served_keys):
                     # lost the re-claim race to a same-id twin: joining
                     # anyway would start a renewer that no-ops forever
                     print(f"fleet: NOT joining {fleet_root} — lost the "
@@ -221,7 +250,9 @@ def _serve_main(argv):
             ledger.release(reason="drain")
 
     asyncio.run(run_server(batcher, host=args.host, port=args.port,
-                           ready=ready, on_drain_start=on_drain_start))
+                           ready=ready, on_drain_start=on_drain_start,
+                           provenance=provenance))
+    alerts_mod.stop()
     return 0
 
 
@@ -288,6 +319,7 @@ def _router_main(argv):
                     help="0 binds an ephemeral port (see the ready line)")
     args = ap.parse_args(argv)
 
+    from raft_tpu.obs import alerts as alerts_mod
     from raft_tpu.serve.router import run_router
 
     root = _default_fleet_dir(args.fleet_dir)
@@ -295,6 +327,11 @@ def _router_main(argv):
         print("--fleet-dir (or RAFT_TPU_FLEET_DIR) is required",
               file=sys.stderr)
         return 2
+    # the router runs the fleet-level alert evaluator: its registry
+    # carries the ladder/breaker/membership/canary counters the
+    # default rule pack watches (RAFT_TPU_ALERT_EVAL_S > 0; served at
+    # GET /alerts)
+    alerts_mod.maybe_start()
 
     def ready(router):
         snap = router.state.snapshot()
@@ -304,6 +341,7 @@ def _router_main(argv):
 
     asyncio.run(run_router(root, host=args.host, port=args.port,
                            ready=ready))
+    alerts_mod.stop()
     return 0
 
 

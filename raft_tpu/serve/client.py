@@ -79,6 +79,10 @@ class ServeClient:
         #: response headers of the last completed round trip (the
         #: distributed-tracing tests read `traceparent` back from here)
         self.last_headers = {}
+        #: parsed x-raft-provenance of the last response (None when the
+        #: server sent no stamp): {bank_key, bank_sha, code, flags,
+        #: replica} — WHAT produced the numbers, through the router too
+        self.last_provenance = None
 
     def _connection(self):
         if self._conn is None:
@@ -151,6 +155,10 @@ class ServeClient:
             raise ResponseDropped(
                 f"connection lost awaiting {method} {path}: {e!r}") from e
         self.last_headers = {k.lower(): v for k, v in resp.getheaders()}
+        from raft_tpu.obs.alerts import parse_provenance
+
+        self.last_provenance = parse_provenance(
+            self.last_headers.get("x-raft-provenance"))
         if resp.will_close:
             self.close()
         try:

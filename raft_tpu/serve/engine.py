@@ -287,6 +287,96 @@ def escalate_row(entry, Hs, Tp, beta, out_keys=DEFAULT_OUT_KEYS, mesh=None):
     return row, int(np.asarray(row["status"]))
 
 
+# ------------------------------------------------------------- provenance
+
+
+def program_identity(entry, mesh=None, out_keys=DEFAULT_OUT_KEYS, rows=None):
+    """The AOT-bank identity of the program that serves ``entry``:
+    ``(entry_key, sidecar_meta | None)`` for the (bucket signature x
+    smallest ladder rung) dispatch — the EXACT key
+    :class:`~raft_tpu.aot.bank.BankedProgram` computes at dispatch
+    time (same memo key, same device-put argument avals), derived
+    without dispatching anything.  Startup-only cost: one device_put
+    of a single packed design row."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from raft_tpu.aot import bank
+    from raft_tpu.parallel.sweep import _flags_key, _mesh_key, make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    out_keys = normalize_out_keys(out_keys)
+    rows = int(rows) if rows else batch_ladder(mesh)[0]
+    case = dict(design=bucketing.stack_packed([entry.packed], rows),
+                Hs=_pad1(np.full(1, 4.0), rows),
+                Tp=_pad1(np.full(1, 9.0), rows),
+                beta=_pad1(np.zeros(1), rows))
+    sharding = NamedSharding(mesh, P("dp"))
+    in_sh = jax.tree_util.tree_map(lambda _: sharding, case)
+    args = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(np.asarray(x), s), case, in_sh)
+    # the full memo key _cached_jit hands the bank: the dispatch tuple
+    # plus the bucket evaluator's program-identity stamp (the signature
+    # IS the program — structure.bucketing.make_bucket_evaluator)
+    pk = ("bucket_evaluator", bank.content_fingerprint(list(entry.sig)))
+    memo = ("bucket", tuple(out_keys), entry.sig, _mesh_key(mesh),
+            _flags_key()) + (("program", pk),)
+    key, _meta = bank.entry_key("bucket", memo, (args,))
+    return key, bank.peek("bucket", memo, (args,))
+
+
+def flags_fingerprint():
+    """Short content hash of the trace-time flag state
+    (:func:`flags_extra`) — the ``flags`` component of the provenance
+    stamp: two replicas under divergent dtype/solver/x64 flags carry
+    different fingerprints even when both are individually healthy."""
+    import hashlib
+
+    return hashlib.sha256(repr(flags_extra()).encode()).hexdigest()[:12]
+
+
+def build_provenance(registry, mesh=None, out_keys=DEFAULT_OUT_KEYS,
+                     sizes=None, replica_id=None):
+    """Per-design provenance stamps for the ``x-raft-provenance``
+    response header: ``{design_name: {bank_key, bank_sha, code, flags,
+    replica}}`` plus a ``"*"`` base entry (code/flags/replica only)
+    for inline designs.  Computed ONCE at startup — per request the
+    stamp is a dict lookup and one precomputed header string, nothing
+    more (the zero-overhead contract).
+
+    The deterministic ``provenance_skew`` fault kind
+    (:mod:`raft_tpu.utils.faults`, site ``serve_provenance``) perturbs
+    the reported bank/code identity — the drill's stand-in for a
+    genuinely stale-banked or env-skewed replica, detected by the
+    router canary's cross-replica consistency check."""
+    from raft_tpu.aot import bank
+    from raft_tpu.utils import faults
+
+    code = bank.code_fingerprint()
+    flags = flags_fingerprint()
+    rid = str(replica_id or f"pid-{os.getpid()}")
+    skewed = faults.take("provenance_skew", "serve_provenance")
+    base = {"code": code, "flags": flags, "replica": rid}
+    out = {"*": dict(base)}
+    for name in registry.names():
+        entry = registry.get(name)
+        try:
+            key, side = program_identity(
+                entry, mesh=mesh, out_keys=out_keys,
+                rows=(sizes[0] if sizes else None))
+        except Exception:  # noqa: BLE001 — provenance is telemetry
+            key, side = None, None
+        d = dict(base)
+        d["bank_key"] = key or "none"
+        d["bank_sha"] = ((side or {}).get("payload_sha256") or "none")[:16]
+        if skewed:
+            d["bank_sha"] = ("skew" + d["bank_sha"])[:16]
+            d["bank_key"] = "skew-" + d["bank_key"]
+        out[name] = d
+    return out
+
+
 # ----------------------------------------------------------------- warmup
 
 

@@ -55,8 +55,12 @@ from raft_tpu.utils.structlog import log_event
 FLEET_DIRNAME = "_fleet"
 
 #: fault kinds targeted at one replica (stripped from the rest by the
-#: coordinator, like the fabric's worker_kill forwarding)
-REPLICA_FAULT_KINDS = ("replica_kill", "replica_hang", "replica_5xx")
+#: coordinator, like the fabric's worker_kill forwarding).
+#: ``provenance_skew`` perturbs the replica's reported bank/code
+#: identity at startup — the canary drill's deterministic stand-in for
+#: a stale-banked or env-skewed replica
+REPLICA_FAULT_KINDS = ("replica_kill", "replica_hang", "replica_5xx",
+                       "provenance_skew")
 
 
 def fleet_dir(root):
@@ -103,12 +107,14 @@ class FleetLedger:
     # ------------------------------------------------------ replica side
 
     def claim(self, port, host="127.0.0.1", designs=None, buckets=None,
-              healthz=None):
+              healthz=None, out_keys=None):
         """Join the fleet: exclusive lease creation for this replica id.
         ``designs`` maps served design name -> {"sig": bucket-signature
         fingerprint, "fingerprint": design content hash} (the router
         hashes these into its ring keys); ``buckets`` is the distinct
-        signature fingerprint list."""
+        signature fingerprint list; ``out_keys`` is the out_keys tuple
+        this replica dispatches (the router canary intersects its probe
+        keys with this — a probe asking for an unserved key is a 400)."""
         os.makedirs(_replicas_dir(self.root), exist_ok=True)
         now = time.time()
         rec = {
@@ -122,6 +128,7 @@ class FleetLedger:
             "ttl_s": float(config.get("FLEET_TTL_S")),
             "designs": dict(designs or {}),
             "buckets": list(buckets or ()),
+            "out_keys": list(out_keys or ()),
             "healthz": dict(healthz or {}),
             "token": self.token,
         }

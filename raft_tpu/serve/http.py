@@ -97,11 +97,19 @@ class Server:
     """One service instance: batcher + asyncio HTTP endpoint."""
 
     def __init__(self, batcher, host="127.0.0.1", port=8787,
-                 on_drain_start=None):
+                 on_drain_start=None, provenance=None):
         self.batcher = batcher
         self.host = host
         self.port = int(port)
         self.timeout_s = float(config.get("SERVE_TIMEOUT_S"))
+        #: per-design provenance stamps ({design: prov dict} plus the
+        #: "*" base for inline designs — engine.build_provenance);
+        #: precomputed ONCE into header strings so the per-request cost
+        #: is a dict lookup (the zero-overhead contract)
+        from raft_tpu.obs.alerts import format_provenance
+
+        self._prov_headers = {k: format_provenance(v)
+                              for k, v in (provenance or {}).items()}
         #: called (in an executor — it does file IO) at the very START
         #: of the graceful drain, before any in-flight work finishes:
         #: the fleet replica releases its membership lease here, so the
@@ -125,21 +133,33 @@ class Server:
                         remote=parse_traceparent(traceparent),
                         client=str(client))
         with req_span:
-            status, payload = await self._evaluate_inner(body, client)
+            status, payload, design = await self._evaluate_inner(body,
+                                                                 client)
         hdrs = {}
         tp = format_traceparent(req_span.trace_id, req_span.span_id) \
             if req_span.span_id else None
         if tp:
             hdrs["traceparent"] = tp
+        # provenance stamp: WHAT produced these numbers — bank key +
+        # sidecar sha, code hash, flags key, replica id (precomputed at
+        # startup; the canary cross-checks it across replicas)
+        prov = (self._prov_headers.get(design)
+                or self._prov_headers.get("*"))
+        if prov:
+            hdrs["x-raft-provenance"] = prov
         return status, payload, hdrs
 
     async def _evaluate_inner(self, body, client):
+        """Returns ``(status, payload, design_key)`` — the design key
+        picks the provenance stamp (``"*"`` = base stamp: inline or
+        unresolved designs)."""
         try:
             payload = json.loads(body or b"{}")
         except (ValueError, UnicodeDecodeError) as e:
-            return 400, {"ok": False, "error": f"bad JSON body: {e}"}
+            return 400, {"ok": False, "error": f"bad JSON body: {e}"}, "*"
         if not isinstance(payload, dict):
-            return 400, {"ok": False, "error": "body must be a JSON object"}
+            return (400, {"ok": False, "error": "body must be a JSON object"},
+                    "*")
         client = payload.get("client") or client
         loop = asyncio.get_running_loop()
         entry = None
@@ -151,33 +171,40 @@ class Server:
                     None, self.batcher.registry.resolve_inline,
                     payload["design_inline"])
             except Exception as e:  # noqa: BLE001 — tenant input
-                return 400, {"ok": False,
-                             "error": f"inline design rejected: {e!r}"}
+                return (400, {"ok": False,
+                              "error": f"inline design rejected: {e!r}"},
+                        "*")
         else:
             name = payload.get("design")
             if not name:
-                return 400, {"ok": False,
-                             "error": "missing 'design' (or 'design_inline')"}
+                return (400, {"ok": False,
+                              "error": "missing 'design' "
+                                       "(or 'design_inline')"}, "*")
             entry = self.batcher.registry.get(name)
             if entry is None:
-                return 404, {"ok": False, "error": f"unknown design {name!r}"}
+                return (404, {"ok": False,
+                              "error": f"unknown design {name!r}"}, "*")
+        design = entry.name
         # the case scalars are REQUIRED: silently defaulting a missing
         # (or misspelled) Hs/Tp/beta would evaluate the wrong sea state
         # and return it as ok:true — in a parity-gated service, wrong
         # numbers must never be quieter than a 400
         missing = [k for k in ("Hs", "Tp", "beta") if k not in payload]
         if missing:
-            return 400, {"ok": False,
-                         "error": f"missing case scalar(s) {missing}"}
+            return (400, {"ok": False,
+                          "error": f"missing case scalar(s) {missing}"},
+                    design)
         try:
             case = {k: float(payload[k]) for k in ("Hs", "Tp", "beta")}
         except (TypeError, ValueError):
-            return 400, {"ok": False, "error": "Hs/Tp/beta must be numbers"}
+            return (400, {"ok": False,
+                          "error": "Hs/Tp/beta must be numbers"}, design)
         out_keys = payload.get("out_keys")
         if out_keys is not None and not (
                 isinstance(out_keys, list)
                 and all(isinstance(k, str) for k in out_keys)):
-            return 400, {"ok": False, "error": "out_keys must be a string list"}
+            return (400, {"ok": False,
+                          "error": "out_keys must be a string list"}, design)
         try:
             fut = self.batcher.submit(
                 entry, case["Hs"], case["Tp"], case["beta"],
@@ -185,22 +212,26 @@ class Server:
                 escalate_f64=bool(payload.get("escalate_f64")),
                 client=client, trace_ctx=current_ids())
         except batcher_mod.QuotaExceeded as e:
-            return 429, {"ok": False, "error": "client quota exceeded",
-                         "retry_after_s": round(e.retry_after_s, 3)}
+            return (429, {"ok": False, "error": "client quota exceeded",
+                          "retry_after_s": round(e.retry_after_s, 3)},
+                    design)
         except batcher_mod.RejectError as e:
-            return 503, {"ok": False, "error": str(e), "reason": e.reason}
+            return (503, {"ok": False, "error": str(e),
+                          "reason": e.reason}, design)
         except ValueError as e:
-            return 400, {"ok": False, "error": str(e)}
+            return 400, {"ok": False, "error": str(e)}, design
         try:
             result = await asyncio.wait_for(asyncio.wrap_future(fut),
                                             timeout=self.timeout_s)
         except asyncio.TimeoutError:
             fut.cancel()
-            return 408, {"ok": False,
-                         "error": f"evaluation exceeded {self.timeout_s}s"}
+            return (408, {"ok": False,
+                          "error": f"evaluation exceeded {self.timeout_s}s"},
+                    design)
         except Exception as e:  # noqa: BLE001 — dispatch failure
-            return 500, {"ok": False, "error": repr(e)[:300]}
-        return (422 if result["severe"] else 200), encode_result(result)
+            return 500, {"ok": False, "error": repr(e)[:300]}, design
+        return (422 if result["severe"] else 200), encode_result(result), \
+            design
 
     def _healthz(self):
         from raft_tpu.analysis.recompile import PROCESS_LOG
@@ -288,6 +319,16 @@ class Server:
             return 405, {"ok": False, "error": "GET required"}
         if path == "/healthz":
             return self._healthz()
+        if path == "/alerts":
+            # live alert-engine state (+ the replica's golden-canary
+            # summary when the canary path is enabled) — pure in-memory
+            # reads, safe on the event loop
+            from raft_tpu.obs import alerts as alerts_mod
+            from raft_tpu.serve import canary as canary_mod
+
+            payload = alerts_mod.endpoint_payload()
+            payload["canary"] = canary_mod.replica_summary()
+            return 200, payload
         if path == "/metrics":
             return 200, metrics.to_prometheus()  # text, not JSON
         if path == "/designs":
@@ -437,12 +478,13 @@ class Server:
 
 
 async def run_server(batcher, host="127.0.0.1", port=8787, ready=None,
-                     on_drain_start=None):
+                     on_drain_start=None, provenance=None):
     """Start + block until signalled.  ``ready(server)`` runs after the
     socket binds (the CLI prints its ready line there; the fleet
     replica claims its membership lease there too)."""
     server = await Server(batcher, host, port,
-                          on_drain_start=on_drain_start).start()
+                          on_drain_start=on_drain_start,
+                          provenance=provenance).start()
     if ready is not None:
         ready(server)
     await server.serve_until_stopped()

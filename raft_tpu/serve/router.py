@@ -248,6 +248,7 @@ class RouterState:
                     "addr": str(rec.get("addr") or "127.0.0.1"),
                     "port": int(rec.get("port") or 0),
                     "designs": dict(rec.get("designs") or {}),
+                    "out_keys": list(rec.get("out_keys") or ()),
                     "healthz": dict(rec.get("healthz") or {}),
                 }
                 if rid not in self._ring:
@@ -268,6 +269,23 @@ class RouterState:
     def key_of(self, payload):
         with self._lock:
             return routing_key(payload, self._designs)
+
+    def design_fingerprints(self):
+        """{design name: content fingerprint} from the lease bodies —
+        the canary's golden-key identity (the same hash the serving
+        result cache keys on)."""
+        with self._lock:
+            return {name: str((d or {}).get("fingerprint") or "")
+                    for name, d in self._designs.items()}
+
+    def served_out_keys(self, rid):
+        """The out_keys tuple a replica's lease declared it dispatches
+        (empty for pre-out_keys leases) — the canary intersects its
+        probe keys with this so a probe never 400s on an unserved
+        key."""
+        with self._lock:
+            info = self._replicas.get(rid)
+            return tuple(info["out_keys"]) if info else ()
 
     def owners(self, key):
         with self._lock:
@@ -505,6 +523,9 @@ class Router:
         #: handlers currently processing a request (vs parked on an
         #: idle keep-alive read): shutdown awaits only these
         self._busy = set()
+        #: the golden-answer canary daemon (None unless
+        #: RAFT_TPU_CANARY_S > 0 — started in start())
+        self.canary = None
 
     # ------------------------------------------------- failover ladder
 
@@ -692,13 +713,21 @@ class Router:
         metrics.counter("router_requests").inc()
         metrics.histogram("router_request_s").observe(wall)
         metrics.window("router_request_window_s").observe(wall)
+        prov = (hdrs.get("x-raft-provenance")
+                if isinstance(hdrs, dict) else None)
         log_event("router_request", replica=rid, code=int(status),
                   attempts=attempts, hedged=bool(hedged),
                   design=str(payload.get("design") or "inline"),
-                  wall_s=round(wall, 6))
+                  wall_s=round(wall, 6), provenance=prov)
         extra = {}
         if isinstance(hdrs, dict) and hdrs.get("traceparent"):
             extra["traceparent"] = hdrs["traceparent"]
+        if prov:
+            # forward the replica's provenance stamp verbatim: the
+            # client sees WHAT produced its numbers even through the
+            # failover front (serve/client.py parses it into
+            # last_provenance)
+            extra["x-raft-provenance"] = prov
         if rid is not None:
             # which replica answered — the affinity drill reads this
             extra["x-raft-replica"] = str(rid)
@@ -737,6 +766,15 @@ class Router:
         if path == "/healthz":
             status, payload = self._healthz()
             return status, payload, {}
+        if path == "/alerts":
+            # live alert-engine state + the router canary's golden/
+            # parity summary — in-memory reads only, loop-safe
+            from raft_tpu.obs import alerts as alerts_mod
+
+            payload = alerts_mod.endpoint_payload()
+            payload["canary"] = (self.canary.canary.summary()
+                                 if self.canary is not None else None)
+            return 200, payload, {}
         if path == "/ring":
             return 200, {"ok": True, "ring": self.state.ring_view()}, {}
         if path == "/designs":
@@ -803,6 +841,16 @@ class Router:
         # must never race an empty membership (ledger IO — executor)
         await loop.run_in_executor(None, self.prober.probe_once)
         self.prober.start()
+        if float(config.get("CANARY_S") or 0) > 0:
+            # golden-answer canary: low-rate probes pinned per replica,
+            # compared bit-for-status / tolerance-for-floats against
+            # content-addressed goldens + cross-replica provenance
+            # consistency (raft_tpu.serve.canary); blocking probe IO
+            # lives on ITS thread, like the membership prober
+            from raft_tpu.serve.canary import RouterCanary
+
+            self.canary = RouterCanary(self.state)
+            self.canary.start()
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
@@ -840,9 +888,25 @@ class Router:
             t.cancel()
         await self._server.wait_closed()
         await loop.run_in_executor(None, self.prober.stop)
+        if self.canary is not None:
+            await loop.run_in_executor(None, self.canary.stop)
         path = config.get("METRICS")
         if path:
             await loop.run_in_executor(None, metrics.export, path)
+        # append the session's run record (RAFT_TPU_RUNS_DIR): the
+        # router's registry at shutdown carries the fleet's routing
+        # story — request/retry/hedge/breaker counters, the sliding
+        # latency window, canary pass/fail — so `obs runs regress`
+        # sees router sessions too (replicas already record theirs in
+        # serve/http.py).  Executor: file IO + a `git rev-parse`
+        # subprocess (obs.runs.git_sha)
+        from raft_tpu.obs import runs as obs_runs
+
+        wall_s = time.perf_counter() - _T0
+        requests = metrics.counter("router_requests").value
+        await loop.run_in_executor(
+            None, lambda: obs_runs.maybe_record(
+                "router", wall_s=wall_s, extra={"requests": requests}))
         log_event("router_stop",
                   requests=metrics.counter("router_requests").value,
                   retries=metrics.counter("router_retries").value)

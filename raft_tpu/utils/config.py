@@ -353,6 +353,42 @@ _register(
          help="serve-client retry budget for clean 429/503 rejections "
               "(capped exponential backoff honoring Retry-After; 0 "
               "disables — rejections return to the caller as-is)"),
+    # -- live fleet health: alert rules + golden canaries (see
+    #    raft_tpu.obs.alerts / raft_tpu.serve.canary and README
+    #    "Alerting & canaries")
+    Flag("ALERT_EVAL_S", "float", 0.0,
+         help="alert-rule evaluation period in seconds (0 disables — "
+              "no thread, no state): a named daemon evaluates the rule "
+              "pack against the live metrics registry, emitting "
+              "alert_fire/alert_resolve events, the alerts_active "
+              "gauge and the RAFT_TPU_ALERTS sink; state is served at "
+              "GET /alerts on replicas and the router"),
+    Flag("ALERT_RULES", "str", "",
+         help="YAML/JSON rule file loaded over the default alert pack "
+              "(same-name rules replace, 'disabled: true' removes, "
+              "top-level 'default_pack: false' starts empty); validate "
+              "with `python -m raft_tpu.obs alerts check`"),
+    Flag("ALERTS", "str", "",
+         help="JSONL sink path for alert fire/resolve records (one "
+              "appended line per transition; unset = no sink)"),
+    Flag("CANARY_S", "float", 0.0,
+         help="golden-answer canary period in seconds (0 disables): on "
+              "the router, a daemon probes every (replica, design) "
+              "pair with a synthetic /evaluate and compares against "
+              "content-addressed goldens (bit-for-status, tolerance-"
+              "for-floats) + cross-replica provenance consistency; on "
+              "a replica, golden rows are captured at warmup"),
+    Flag("CANARY_OUT_KEYS", "str", "X0,status",
+         help="out_keys the canary probes request and compares "
+              "(status is always included; keep these small — X0 is "
+              "6 floats, PSD is a full grid)"),
+    Flag("CANARY_RTOL", "float", 1e-5,
+         help="relative tolerance of the canary's float-output "
+              "comparison against the golden row (status bits are "
+              "always compared exactly)"),
+    Flag("CANARY_ATOL", "float", 1e-8,
+         help="absolute tolerance of the canary's float-output "
+              "comparison against the golden row"),
     # -- serving fleet: replica membership ledger (see raft_tpu.serve.
     #    fleet and README "Serving fleet")
     Flag("FLEET_DIR", "str", "",

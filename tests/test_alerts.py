@@ -1,0 +1,697 @@
+"""Live fleet-health fast units: alert-rule parsing/predicates/
+for-duration/resolve hysteresis, rule-file loading, the default pack,
+record replay (``alerts eval --record``), golden-canary capture +
+tolerance comparison, provenance codec round-trip and cross-replica
+consistency, and the obs-report alerts/provenance sections.
+
+Everything here is socket-free and compile-free (tier-1): the engine
+runs on an injected clock, the canary core is fed synthetic rows, and
+the CLI verbs are called in-process.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests._obs_helpers import read_events
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "runs")
+
+
+# ---------------------------------------------------------- rule parsing
+
+
+def test_parse_rule_good_and_bad():
+    from raft_tpu.obs.alerts import parse_rule
+
+    r = parse_rule({"name": "x", "metric": "counter:serve_errors",
+                    "predicate": "rate_above", "threshold": 2,
+                    "for_s": 1, "clear_s": 3, "severity": "critical",
+                    "context": "canary_parity", "help": "h"})
+    assert r.name == "x" and r.threshold == 2.0 and r.clear_s == 3.0
+    assert r.context == "canary_parity"
+    with pytest.raises(ValueError, match="name"):
+        parse_rule({"metric": "counter:x", "predicate": "above"})
+    with pytest.raises(ValueError, match="selector"):
+        parse_rule({"name": "x", "metric": "serve_errors",
+                    "predicate": "above"})
+    with pytest.raises(ValueError, match="predicate"):
+        parse_rule({"name": "x", "metric": "counter:x",
+                    "predicate": "gte"})
+    with pytest.raises(ValueError, match="severity"):
+        parse_rule({"name": "x", "metric": "counter:x",
+                    "predicate": "above", "severity": "page"})
+    with pytest.raises(ValueError, match="for_s"):
+        parse_rule({"name": "x", "metric": "counter:x",
+                    "predicate": "above", "for_s": -1})
+    with pytest.raises(ValueError, match="unknown field"):
+        parse_rule({"name": "x", "metric": "counter:x",
+                    "predicate": "above", "threshhold": 3})
+
+
+def test_load_rules_json_yaml_override_disable(tmp_path):
+    from raft_tpu.obs.alerts import default_rules, load_rules
+
+    names = {r.name for r in default_rules()}
+    assert {"slo-breach", "breaker-storm", "lease-churn",
+            "cache-hit-collapse", "compile-budget-burn",
+            "canary-failure", "canary-parity"} == names
+    # default pack when no file
+    assert {r.name for r in load_rules(None)} == names
+    # JSON: override one (same name replaces), add one, disable one
+    jf = tmp_path / "rules.json"
+    jf.write_text(json.dumps({"rules": [
+        {"name": "slo-breach", "metric": "counter:serve_slo_breaches",
+         "predicate": "rate_above", "threshold": 9.0},
+        {"name": "my-rule", "metric": "hist:serve_request_s:p95",
+         "predicate": "above", "threshold": 2.0},
+        {"name": "lease-churn", "disabled": True},
+    ]}))
+    rules = {r.name: r for r in load_rules(str(jf))}
+    assert rules["slo-breach"].threshold == 9.0
+    assert "my-rule" in rules and "lease-churn" not in rules
+    # YAML: default_pack false starts empty
+    yf = tmp_path / "rules.yaml"
+    yf.write_text("default_pack: false\n"
+                  "rules:\n"
+                  "  - name: only\n"
+                  "    metric: gauge:router_replicas:value\n"
+                  "    predicate: below\n"
+                  "    threshold: 2\n")
+    only = load_rules(str(yf))
+    assert [r.name for r in only] == ["only"]
+    # a bad file is a loud ValueError (the `alerts check` exit-1 path)
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"rules": [{"name": "x"}]}))
+    with pytest.raises(ValueError):
+        load_rules(str(bad))
+
+
+def test_rate_rule_fires_on_mid_life_minted_counter():
+    """Counters are created on their FIRST increment (breaker opens,
+    evictions, canary failures) — a counter appearing after the
+    engine's first pass must register as a rate from 0, not silently
+    become the baseline; totals pre-existing the engine (warmup
+    compiles) must baseline without firing."""
+    from raft_tpu.obs.alerts import Rule
+
+    eng, _ = _engine([
+        Rule("storm", "counter:router_breaker_opens", "rate_above",
+             threshold=0.0, clear_s=0.0),
+        Rule("burn", "counter:xla_real_compiles", "rate_above",
+             threshold=0.0, clear_s=0.0)])
+    # first pass: warmup compiles already at 5 — baseline, NO fire
+    assert eng.evaluate({"counter:xla_real_compiles": 5.0}, now=0.0) == []
+    # breaker counter minted mid-life (SIGKILL just landed): fires on
+    # the very next pass — "within one eval interval"
+    t = eng.evaluate({"counter:xla_real_compiles": 5.0,
+                      "counter:router_breaker_opens": 1.0}, now=1.0)
+    assert [(x["rule"], x["kind"]) for x in t] == [("storm", "fire")]
+    # storm over: opens flat -> resolve
+    t = eng.evaluate({"counter:xla_real_compiles": 5.0,
+                      "counter:router_breaker_opens": 1.0}, now=2.0)
+    assert [(x["rule"], x["kind"]) for x in t] == [("storm", "resolve")]
+
+
+# ------------------------------------------------------------ predicates
+
+
+def _engine(rules, sink=None):
+    from raft_tpu.obs.alerts import AlertEngine
+
+    clock = [0.0]
+    eng = AlertEngine(rules, sink_path=sink, clock=lambda: clock[0])
+    return eng, clock
+
+
+def test_predicates_above_below_rate_absent():
+    from raft_tpu.obs.alerts import Rule
+
+    eng, _clock = _engine([
+        Rule("a", "gauge:g:value", "above", threshold=5.0),
+        Rule("b", "gauge:g:value", "below", threshold=1.0),
+        Rule("r", "counter:c", "rate_above", threshold=2.0),
+        Rule("m", "counter:gone", "absent"),
+    ])
+    # t=0: establishes the rate baseline; gauge mid-range; counter
+    # present -> only the absence rule can fire (metric 'gone' missing)
+    t1 = eng.evaluate({"gauge:g:value": 3.0, "counter:c": 0.0}, now=0.0)
+    assert [t["rule"] for t in t1] == ["m"]
+    # t=10: counter +30 in 10s = 3/s > 2 -> rate fires; gauge 6 > 5
+    t2 = eng.evaluate({"gauge:g:value": 6.0, "counter:c": 30.0,
+                       "counter:gone": 1.0}, now=10.0)
+    assert sorted(t["rule"] for t in t2 if t["kind"] == "fire") \
+        == ["a", "r"]
+    assert [t["rule"] for t in t2 if t["kind"] == "resolve"] == ["m"]
+    # t=20: counter flat -> rate 0 -> resolve; gauge 0.5 < 1 -> below
+    t3 = eng.evaluate({"gauge:g:value": 0.5, "counter:c": 30.0},
+                      now=20.0)
+    kinds = {(t["rule"], t["kind"]) for t in t3}
+    assert ("b", "fire") in kinds and ("r", "resolve") in kinds
+    assert ("a", "resolve") in kinds
+    # counter RESET (process restart): a drop must re-baseline, never
+    # fire as a negative-or-huge rate
+    t4 = eng.evaluate({"gauge:g:value": 0.5, "counter:c": 1.0}, now=30.0)
+    assert not any(t["rule"] == "r" for t in t4)
+
+
+def test_for_duration_and_resolve_hysteresis():
+    from raft_tpu.obs.alerts import Rule
+
+    eng, _ = _engine([Rule("slow", "gauge:g:value", "above",
+                           threshold=1.0, for_s=10.0, clear_s=5.0)])
+    # condition true but younger than for_s: pending, no fire
+    assert eng.evaluate({"gauge:g:value": 2.0}, now=0.0) == []
+    assert eng.evaluate({"gauge:g:value": 2.0}, now=9.0) == []
+    t = eng.evaluate({"gauge:g:value": 2.0}, now=10.0)
+    assert [x["kind"] for x in t] == ["fire"]
+    assert eng.active() and eng.active()[0]["rule"] == "slow"
+    # a blip below the threshold RESETS the pending clock next time,
+    # but a firing alert needs clear_s of clean before resolving
+    assert eng.evaluate({"gauge:g:value": 0.0}, now=12.0) == []  # clean 0s
+    # condition returns inside the clear window: still firing, no
+    # re-fire event (hysteresis absorbs the flap)
+    assert eng.evaluate({"gauge:g:value": 2.0}, now=14.0) == []
+    assert eng.evaluate({"gauge:g:value": 0.0}, now=20.0) == []
+    t = eng.evaluate({"gauge:g:value": 0.0}, now=25.0)
+    assert [x["kind"] for x in t] == ["resolve"]
+    assert t[0]["duration_s"] == pytest.approx(15.0)
+    assert eng.active() == []
+    # pending was reset by the earlier dip: a fresh fire needs a fresh
+    # uninterrupted for_s window
+    assert eng.evaluate({"gauge:g:value": 2.0}, now=26.0) == []
+    assert [x["kind"] for x in
+            eng.evaluate({"gauge:g:value": 2.0}, now=36.0)] == ["fire"]
+
+
+def test_fire_emits_events_sink_gauge_and_context(tmp_path, monkeypatch):
+    from raft_tpu.obs import alerts, metrics
+    from raft_tpu.obs.alerts import Rule, read_sink
+
+    metrics.reset()
+    log = tmp_path / "events.jsonl"
+    sink = tmp_path / "alerts.jsonl"
+    monkeypatch.setenv("RAFT_TPU_LOG", str(log))
+    eng, _ = _engine([Rule("boom", "counter:c", "above", threshold=0.0,
+                           severity="critical", context="canary_parity")],
+                     sink=str(sink))
+    alerts.set_context("canary_parity", {"offending": "rB"})
+    try:
+        eng.evaluate({"counter:c": 3.0}, now=1.0)
+        assert metrics.gauge("alerts_active").value == 1.0
+        assert metrics.counter("alerts_fired").value == 1
+        eng.evaluate({"counter:c": 0.0}, now=2.0)
+        assert metrics.gauge("alerts_active").value == 0.0
+        assert metrics.counter("alerts_resolved").value == 1
+    finally:
+        alerts.set_context("canary_parity", None)
+    fires = read_events(log, name="alert_fire")
+    resolves = read_events(log, name="alert_resolve")
+    assert len(fires) == 1 and len(resolves) == 1
+    assert fires[0]["rule"] == "boom" and fires[0]["severity"] == "critical"
+    assert fires[0]["context"] == {"offending": "rB"}
+    assert resolves[0]["duration_s"] == pytest.approx(1.0)
+    # the JSONL sink holds the same two transition records
+    records, bad = read_sink(str(sink))
+    assert bad == 0 and [r["kind"] for r in records] == ["fire", "resolve"]
+    assert records[0]["rule"] == "boom"
+    assert records[0]["context"] == {"offending": "rB"}
+    assert records[1]["duration_s"] == pytest.approx(1.0)
+    from raft_tpu.obs.alerts import render_sink_summary
+
+    lines = render_sink_summary(records)
+    assert len(lines) == 2 and "boom" in lines[0]
+
+
+def test_flatten_snapshot_gauge_value_and_derived(monkeypatch):
+    from raft_tpu.obs import metrics
+    from raft_tpu.obs.alerts import flatten_snapshot
+
+    metrics.reset()
+    metrics.counter("serve_cache_hits").inc(3)
+    metrics.counter("serve_cache_misses").inc(1)
+    metrics.gauge("canary_parity_ok").set(0.0)
+    metrics.histogram("serve_request_s").observe(0.1)
+    flat = flatten_snapshot(metrics.snapshot())
+    assert flat["derived:serve_cache_hit_rate"] == pytest.approx(0.75)
+    assert flat["gauge:canary_parity_ok:value"] == 0.0
+    assert flat["counter:serve_cache_hits"] == 3.0
+    assert "hist:serve_request_s:p95" in flat
+    metrics.reset()
+
+
+def test_maybe_start_zero_overhead(monkeypatch):
+    from raft_tpu.obs import alerts
+
+    monkeypatch.delenv("RAFT_TPU_ALERT_EVAL_S", raising=False)
+    assert alerts.maybe_start() is None
+    assert alerts.installed_engine() is None
+    payload = alerts.endpoint_payload()
+    assert payload["enabled"] is False and payload["active"] == []
+    alerts.stop()  # idempotent no-op
+
+
+def test_maybe_start_and_stop_lifecycle(monkeypatch, tmp_path):
+    from raft_tpu.obs import alerts
+
+    monkeypatch.setenv("RAFT_TPU_ALERT_EVAL_S", "30")
+    try:
+        daemon = alerts.maybe_start()
+        assert daemon is not None and daemon.is_alive()
+        assert daemon.daemon and daemon.name == "raft-alert-eval"
+        assert alerts.maybe_start() is daemon  # idempotent
+        payload = alerts.endpoint_payload()
+        assert payload["enabled"] and len(payload["rules"]) == 7
+    finally:
+        alerts.stop()
+    assert alerts.installed_engine() is None
+    assert not daemon.is_alive()
+
+
+# ---------------------------------------------------------------- replay
+
+
+def test_alerts_eval_cli_clean_and_seeded(capsys):
+    from raft_tpu.obs.__main__ import main
+
+    assert main(["alerts", "check"]) == 0
+    assert main(["alerts", "eval", "--record",
+                 os.path.join(FIXTURES, "clean.json")]) == 0
+    rc = main(["alerts", "eval", "--record",
+               os.path.join(FIXTURES, "alerting.json")])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "breaker-storm" in out and "canary-parity" in out
+    assert "slo-breach" in out
+
+
+def test_alerts_check_cli_rejects_bad_file(tmp_path, capsys):
+    from raft_tpu.obs.__main__ import main
+
+    bad = tmp_path / "r.json"
+    bad.write_text(json.dumps({"rules": [
+        {"name": "x", "metric": "nope", "predicate": "above"}]}))
+    assert main(["alerts", "check", "--rules", str(bad)]) == 1
+    assert main(["alerts", "list"]) == 0
+    assert "breaker-storm" in capsys.readouterr().out
+
+
+def test_compile_budget_burn_sees_sentinel_counts():
+    """The recompile sentinel's counts live OUTSIDE the metrics
+    snapshot (record['compiles'], /healthz) — flatten must fold them
+    into the counter: namespace or the compile-budget-burn rule can
+    never fire, live or in replay."""
+    from raft_tpu.obs.alerts import (default_rules, flatten_record,
+                                     replay_rules)
+
+    record = {"snapshot": {}, "compiles": {"xla_compiles": 12,
+                                           "xla_real_compiles": 3}}
+    flat = flatten_record(record)
+    assert flat["counter:xla_real_compiles"] == 3.0
+    assert flat["counter:xla_compiles"] == 12.0
+    fired, _checked = replay_rules(default_rules(), record)
+    assert [f["rule"] for f in fired] == ["compile-budget-burn"]
+    # a genuinely-in-snapshot counter of the same name wins (setdefault)
+    flat2 = flatten_record({"snapshot": {"counters":
+                                         {"xla_real_compiles": 7}},
+                            "compiles": {"xla_real_compiles": 3}})
+    assert flat2["counter:xla_real_compiles"] == 7.0
+
+
+def test_replay_rate_rules_use_replay_threshold():
+    from raft_tpu.obs.alerts import Rule, replay_rules
+
+    record = {"snapshot": {"counters": {"shard_retries": 2}}}
+    # cumulative 2 > replay_above 0 -> fires; raising replay_above
+    # above the total silences it; absent metric does not apply
+    fired, checked = replay_rules(
+        [Rule("r", "counter:shard_retries", "rate_above", threshold=5.0),
+         Rule("quiet", "counter:shard_retries", "rate_above",
+              threshold=5.0, replay_above=10.0),
+         Rule("gone", "counter:never_minted", "rate_above")], record)
+    assert checked == 2
+    assert [f["rule"] for f in fired] == ["r"]
+
+
+# ---------------------------------------------------------------- canary
+
+
+def _mk_canary(rtol=1e-5, atol=1e-8):
+    from raft_tpu.serve.canary import CanaryState
+
+    return CanaryState(rtol=rtol, atol=atol)
+
+
+def _row(x0=(1.0, 2.0, 3.0), status=0):
+    return {"X0": np.asarray(x0, dtype=float),
+            "status": np.int32(status)}
+
+
+def test_canary_golden_capture_and_tolerance_compare(monkeypatch):
+    from raft_tpu.obs import metrics
+
+    metrics.reset()
+    c = _mk_canary(rtol=1e-6, atol=1e-9)
+    keys = ("X0", "status")
+    row = _row()
+    prov = {"bank_sha": "aa", "code": "cc", "flags": "ff", "replica": "rA"}
+    v = c.observe("spar", "rA", "fp-spar", (4.0, 9.0, 0.0), keys,
+                  row, row["status"], provenance=prov)
+    assert v["ok"] and v["golden_created"] and v["reason"] == "golden"
+    # bit-identical repeat from another replica (same provenance modulo
+    # replica id): pass
+    v = c.observe("spar", "rB", "fp-spar", (4.0, 9.0, 0.0), keys,
+                  _row(), np.int32(0),
+                  provenance={**prov, "replica": "rB"})
+    assert v["ok"] and not v["golden_created"]
+    # inside tolerance: pass; outside: fail with a named delta
+    v = c.observe("spar", "rB", "fp-spar", (4.0, 9.0, 0.0), keys,
+                  _row((1.0 + 1e-9, 2.0, 3.0)), 0,
+                  provenance={**prov, "replica": "rB"})
+    assert v["ok"]
+    v = c.observe("spar", "rB", "fp-spar", (4.0, 9.0, 0.0), keys,
+                  _row((1.1, 2.0, 3.0)), 0,
+                  provenance={**prov, "replica": "rB"})
+    assert not v["ok"] and "delta" in v["reason"]
+    assert metrics.gauge("canary_parity_ok").value == 0.0
+    assert metrics.counter("canary_fail").value == 1
+    # a clean follow-up clears the failing key and parity recovers
+    v = c.observe("spar", "rB", "fp-spar", (4.0, 9.0, 0.0), keys,
+                  _row(), 0, provenance={**prov, "replica": "rB"})
+    assert v["ok"] and metrics.gauge("canary_parity_ok").value == 1.0
+    metrics.reset()
+
+
+def test_canary_status_is_bit_exact():
+    c = _mk_canary(rtol=1.0, atol=1.0)  # floats effectively ignored
+    keys = ("X0", "status")
+    c.observe("spar", "rA", "fp", (4.0, 9.0, 0.0), keys, _row(), 4)
+    v = c.observe("spar", "rB", "fp", (4.0, 9.0, 0.0), keys, _row(), 6)
+    assert not v["ok"] and "bit-exact" in v["reason"]
+    # same bits pass even when SEVERE: determinism, not health, is the
+    # canary's contract
+    v = c.observe("spar", "rB", "fp", (4.0, 9.0, 0.0), keys, _row(), 4)
+    assert v["ok"]
+
+
+def test_canary_provenance_split_sets_context(monkeypatch):
+    from raft_tpu.obs import alerts, metrics
+    from raft_tpu.obs.alerts import Rule
+
+    metrics.reset()
+    c = _mk_canary()
+    keys = ("X0", "status")
+    good = {"bank_key": "k1", "bank_sha": "aaaa", "code": "c1",
+            "flags": "f1", "replica": "rA"}
+    skew = {"bank_key": "skew-k1", "bank_sha": "skewaaaa", "code": "c1",
+            "flags": "f1", "replica": "rB"}
+    c.observe("spar", "rA", "fp", (4.0, 9.0, 0.0), keys, _row(), 0,
+              provenance=good)
+    v = c.observe("spar", "rB", "fp", (4.0, 9.0, 0.0), keys, _row(), 0,
+                  provenance=skew)
+    # numerically identical, yet the provenance split alarms — the
+    # stale-bank/env-skew class health bits cannot see
+    assert not v["ok"] and v["provenance_ok"] is False
+    assert metrics.gauge("canary_parity_ok").value == 0.0
+    ctx = alerts.get_context("canary_parity")
+    assert ctx is not None
+    splits = ctx["provenance"]["splits"]
+    fields = {s["field"] for s in splits}
+    assert {"bank_sha", "bank_key"} <= fields
+    by_field = {s["field"]: s for s in splits}
+    assert by_field["bank_sha"]["values"]["rB"] == "skewaaaa"
+    # the canary-parity rule fires on the gauge and carries the context
+    from raft_tpu.obs.alerts import AlertEngine, flatten_snapshot
+
+    eng = AlertEngine([r for r in alerts.default_rules()
+                       if r.name == "canary-parity"],
+                      clock=lambda: 100.0)
+    t = eng.evaluate(flatten_snapshot(metrics.snapshot()))
+    assert [x["kind"] for x in t] == ["fire"]
+    assert t[0]["context"]["provenance"]["splits"]
+    summary = c.summary()
+    assert summary["parity_ok"] is False
+    assert not summary["provenance"]["consistent"]
+    alerts.set_context("canary_parity", None)
+    metrics.reset()
+
+
+def test_canary_prune_clears_departed_replica_ghost(monkeypatch):
+    """A replaced replica's provenance stamp must not ghost-split
+    parity forever: pruning to the current membership recovers the
+    gauge and clears the alert context (the rolling-upgrade story)."""
+    from raft_tpu.obs import alerts, metrics
+
+    metrics.reset()
+    c = _mk_canary()
+    keys = ("X0", "status")
+    old = {"bank_key": "k", "bank_sha": "aaaa", "code": "OLD",
+           "flags": "f", "replica": "rA"}
+    new = {"bank_key": "k", "bank_sha": "aaaa", "code": "NEW",
+           "flags": "f", "replica": "rC"}
+    c.observe("spar", "rA", "fp", (4.0, 9.0, 0.0), keys, _row(), 0,
+              provenance=old)
+    v = c.observe("spar", "rC", "fp", (4.0, 9.0, 0.0), keys, _row(), 0,
+                  provenance=new)
+    assert not v["provenance_ok"]            # genuine split while both live
+    assert metrics.gauge("canary_parity_ok").value == 0.0
+    # rA drains and leaves the fleet: prune to the surviving membership
+    assert c.prune({"rC"}) is True
+    assert metrics.gauge("canary_parity_ok").value == 1.0
+    assert alerts.get_context("canary_parity") is None
+    assert c.summary()["parity_ok"] is True
+    assert c.prune({"rC"}) is False          # idempotent no-op
+    metrics.reset()
+
+
+def test_read_sink_requires_kind(tmp_path):
+    from raft_tpu.obs.alerts import read_sink, render_sink_summary
+
+    sink = tmp_path / "s.jsonl"
+    sink.write_text(json.dumps({"rule": "x"}) + "\n"
+                    + json.dumps({"kind": "fire", "rule": "y",
+                                  "severity": "info", "metric": "m",
+                                  "value": 1}) + "\n")
+    records, bad = read_sink(str(sink))
+    assert bad == 1 and [r["rule"] for r in records] == ["y"]
+    assert len(render_sink_summary(records)) == 1  # no KeyError
+
+
+def test_router_canary_probe_intersects_lease_out_keys(monkeypatch):
+    """A replica whose lease declares a narrower served out_keys set
+    is probed with the intersection (status-only at minimum) — a probe
+    asking for an unserved key would 400 and the canary would be
+    silently inert."""
+    from raft_tpu.obs import metrics
+    from raft_tpu.serve.canary import RouterCanary
+    from raft_tpu.serve.router import RouterState
+
+    metrics.reset()
+    monkeypatch.setenv("RAFT_TPU_CANARY_S", "30")
+    monkeypatch.delenv("RAFT_TPU_CANARY_OUT_KEYS", raising=False)
+    state = RouterState(vnodes=8)
+    state.apply_membership({
+        "narrow": {"addr": "h", "port": 1, "out_keys": ["PSD", "status"],
+                   "designs": {"spar": {"sig": "s", "fingerprint": "fp"}}},
+        "legacy": {"addr": "h", "port": 2,   # pre-out_keys lease
+                   "designs": {"spar": {"sig": "s", "fingerprint": "fp"}}},
+    })
+    assert state.served_out_keys("narrow") == ("PSD", "status")
+    assert state.served_out_keys("legacy") == ()
+    asked = {}
+
+    def probe(addr, port, design, case, out_keys):
+        asked[port] = out_keys
+        return 200, {"ok": True, "status": 0,
+                     "outputs": {"status": 0}}, None
+
+    rc = RouterCanary(state, probe=probe)
+    rc.probe_once()
+    # narrow lease: X0 is unserved -> probe asks status only; the
+    # legacy lease declares nothing -> configured default
+    assert asked[1] == ("status",)
+    assert asked[2] == ("X0", "status")
+    metrics.reset()
+
+
+def test_decode_outputs_complex_round_trip():
+    from raft_tpu.serve.canary import decode_outputs
+    from raft_tpu.serve.http import _json_value
+
+    z = np.asarray([1.0 + 2.0j, -0.5 - 1.0j])
+    x = np.asarray([1.5, 2.5])
+    decoded = decode_outputs({"Z": _json_value(z), "X": _json_value(x)})
+    np.testing.assert_array_equal(decoded["Z"], z)
+    np.testing.assert_array_equal(decoded["X"], x)
+
+
+def test_canary_out_keys_served_intersection(monkeypatch):
+    from raft_tpu.serve.canary import canary_out_keys
+
+    monkeypatch.delenv("RAFT_TPU_CANARY_OUT_KEYS", raising=False)
+    assert canary_out_keys() == ("X0", "status")
+    assert canary_out_keys(served=("PSD", "status")) == ("status",)
+    monkeypatch.setenv("RAFT_TPU_CANARY_OUT_KEYS", "PSD,X0")
+    assert canary_out_keys(served=("PSD", "X0", "status")) \
+        == ("PSD", "X0", "status")
+
+
+def test_router_canary_probe_once_with_injected_probe(monkeypatch):
+    """Socket-free router-canary pass: injected probe fn, RouterState
+    membership — verdicts flow per (replica, design) and a skewed
+    replica is named."""
+    from raft_tpu.obs import alerts, metrics
+    from raft_tpu.serve.canary import RouterCanary
+    from raft_tpu.serve.router import RouterState
+
+    metrics.reset()
+    monkeypatch.setenv("RAFT_TPU_CANARY_S", "30")
+    state = RouterState(vnodes=8)
+    state.apply_membership({
+        "rA": {"addr": "127.0.0.1", "port": 1,
+               "designs": {"spar": {"sig": "s", "fingerprint": "fp"}}},
+        "rB": {"addr": "127.0.0.1", "port": 2,
+               "designs": {"spar": {"sig": "s", "fingerprint": "fp"}}},
+    })
+    provs = {1: {"bank_sha": "aaaa", "bank_key": "k", "code": "c",
+                 "flags": "f", "replica": "rA"},
+             2: {"bank_sha": "bbbb", "bank_key": "skew-k", "code": "c",
+                 "flags": "f", "replica": "rB"}}
+
+    def probe(addr, port, design, case, out_keys):
+        body = {"ok": True, "status": 0, "cache_hit": False,
+                "outputs": {"X0": [1.0, 2.0], "status": 0}}
+        return 200, body, provs[port]
+
+    rc = RouterCanary(state, probe=probe)
+    assert rc.daemon and rc.name == "raft-router-canary"
+    verdicts = rc.probe_once()
+    assert len(verdicts) == 2
+    assert verdicts[0]["ok"]              # first probe mints the golden
+    assert not verdicts[1]["provenance_ok"]
+    assert metrics.counter("canary_fail").value == 1
+    summary = rc.canary.summary()
+    assert summary["goldens"] == 1 and not summary["parity_ok"]
+    split_values = summary["provenance"]["splits"][0]["values"]
+    assert set(split_values) == {"rA", "rB"}
+    alerts.set_context("canary_parity", None)
+    metrics.reset()
+
+
+# ------------------------------------------------------ provenance codec
+
+
+def test_provenance_format_parse_round_trip():
+    from raft_tpu.obs.alerts import format_provenance, parse_provenance
+
+    prov = {"bank_key": "abc123", "bank_sha": "deadbeef",
+            "code": "c0ffee", "flags": "f00", "replica": "rA-1"}
+    s = format_provenance(prov)
+    assert s == ("bank_key=abc123;bank_sha=deadbeef;code=c0ffee;"
+                 "flags=f00;replica=rA-1")
+    assert parse_provenance(s) == prov
+    # header-hostile characters are sanitized, never smuggled
+    s2 = format_provenance({"bank_key": "a;b=c d", "replica": "r"})
+    assert ";b" not in s2.split(";", 1)[1] if ";" in s2 else True
+    assert parse_provenance(s2)["bank_key"] == "a_b_c_d"
+    # garbled/empty values parse to None, never crash
+    assert parse_provenance(None) is None
+    assert parse_provenance("") is None
+    assert parse_provenance("no-equals-signs") is None
+
+
+def test_provenance_consistency_verdicts():
+    from raft_tpu.obs.alerts import provenance_consistency
+
+    a = {"bank_sha": "x", "bank_key": "k", "code": "c", "flags": "f",
+         "replica": "rA"}
+    b = {**a, "replica": "rB"}
+    ok = provenance_consistency({"spar": {"rA": a, "rB": b}})
+    assert ok["consistent"] and ok["splits"] == []
+    # replica id differing is NOT a split; bank_sha differing is
+    bad = provenance_consistency(
+        {"spar": {"rA": a, "rB": {**b, "bank_sha": "y"}}})
+    assert not bad["consistent"]
+    assert bad["splits"][0]["field"] == "bank_sha"
+    assert bad["splits"][0]["values"] == {"rA": "x", "rB": "y"}
+    # one replica per design: nothing to compare
+    assert provenance_consistency({"spar": {"rA": a}})["consistent"]
+
+
+# ------------------------------------------------------- report sections
+
+
+def _anchor():
+    return {"t": 0.0, "event": "proc_start", "unix_t": 0.0,
+            "argv0": "x", "pid": 1}
+
+
+def test_report_alerts_and_canary_section():
+    from raft_tpu.obs.report import render_report, report_data
+
+    events = [_anchor()]
+    events.append({"t": 1.0, "pid": 1, "event": "alert_fire",
+                   "rule": "breaker-storm", "severity": "critical",
+                   "metric": "counter:router_breaker_opens",
+                   "value": 1.0, "threshold": 0.0, "context": None})
+    events.append({"t": 2.0, "pid": 1, "event": "alert_resolve",
+                   "rule": "breaker-storm", "severity": "critical",
+                   "metric": "counter:router_breaker_opens",
+                   "duration_s": 1.0, "value": 0.0})
+    events.append({"t": 3.0, "pid": 1, "event": "alert_fire",
+                   "rule": "canary-parity", "severity": "critical",
+                   "metric": "gauge:canary_parity_ok:value",
+                   "value": 0.0, "threshold": 1.0,
+                   "context": {"failing": {}}})
+    events.append({"t": 0.5, "pid": 1, "event": "canary_golden",
+                   "design": "spar", "key": "k", "status": 0,
+                   "replica": "rA"})
+    for i, ok in enumerate((True, True, False)):
+        events.append({"t": 1.0 + i, "pid": 1, "event": "canary_check",
+                       "design": "spar", "replica": "rB", "ok": ok,
+                       "reason": "match" if ok else "status 4 != 0",
+                       "provenance_ok": ok, "key": "k"})
+    data = report_data(events)
+    a = data["alerts"]
+    assert a["rules"]["breaker-storm"] == {"severity": "critical",
+                                           "fires": 1, "resolves": 1}
+    assert a["active_at_end"] == ["canary-parity"]
+    assert a["canary"] == {"goldens": 1, "checks": 3, "failed": 1,
+                           "provenance_failures": 1}
+    text = render_report(events)
+    assert "alerts & canaries" in text
+    assert "STILL FIRING at capture end: canary-parity" in text
+    assert "1 failed (1 provenance split(s))" in text
+    # no alert/canary events -> no section
+    assert report_data([_anchor()])["alerts"] is None
+
+
+def test_report_router_provenance_consistency_line():
+    from raft_tpu.obs.alerts import format_provenance
+    from raft_tpu.obs.report import render_report, report_data
+
+    good = format_provenance({"bank_key": "k", "bank_sha": "aaaa",
+                              "code": "c", "flags": "f", "replica": "rA"})
+    skew = format_provenance({"bank_key": "k", "bank_sha": "bbbb",
+                              "code": "c", "flags": "f", "replica": "rB"})
+    events = [_anchor()]
+    for i, (rid, prov) in enumerate((("rA", good), ("rB", good))):
+        events.append({"t": 0.1 * i, "pid": 1, "event": "router_request",
+                       "replica": rid, "code": 200, "attempts": 1,
+                       "hedged": False, "design": "spar",
+                       "wall_s": 0.01, "provenance": prov})
+    data = report_data(events)
+    prov = data["router"]["provenance"]
+    assert prov["consistent"] and prov["replicas"] == ["rA", "rB"]
+    assert "provenance: consistent" in render_report(events)
+    # divergent bank sha on rB: the section names the split
+    events[-1]["provenance"] = skew
+    data = report_data(events)
+    prov = data["router"]["provenance"]
+    assert not prov["consistent"]
+    assert prov["splits"][0]["values"]["rB"] == "bbbb"
+    text = render_report(events)
+    assert "INCONSISTENT" in text and "rB=bbbb" in text

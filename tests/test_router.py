@@ -672,7 +672,14 @@ def test_kill_a_replica_drill_zero_dropped(warm_bank, tmp_path):
     200/422 (zero dropped responses), the dead lease expires and is
     evicted from the ring, a replacement joins from the warm bank with
     zero backend compiles, a drain re-routes mid-flight work, and the
-    whole session merges onto one trace with 0 orphan spans."""
+    whole session merges onto one trace with 0 orphan spans.
+
+    PR 14 rides the live fleet-health layer along: the router runs the
+    alert engine (RAFT_TPU_ALERT_EVAL_S) + golden canary
+    (RAFT_TPU_CANARY_S) — the SIGKILL must fire the breaker-storm
+    alert within an eval interval and resolve it after the storm ends,
+    the canary must pass on the bank-consistent fleet, and the steady
+    phase must fire ZERO alerts (no false positives)."""
     from raft_tpu.serve.client import ServeClient
     from raft_tpu.serve.fleet import FleetLedger
     from raft_tpu.serve.router import HashRing, routing_key
@@ -680,9 +687,17 @@ def test_kill_a_replica_drill_zero_dropped(warm_bank, tmp_path):
     logdir = tmp_path / "logs"
     logdir.mkdir()
     root = tmp_path / "deploy"
-    env = _fleet_env(warm_bank, logdir)
+    alert_sink = tmp_path / "alerts.jsonl"
+    # canary on fleet-wide: replicas capture their warmup goldens, the
+    # router probes; the alert engine runs on the ROUTER only (its
+    # registry carries the breaker/eviction counters the pack watches)
+    env = _fleet_env(warm_bank, logdir,
+                     extra={"RAFT_TPU_CANARY_S": "0.5"})
+    router_alert_env = {"RAFT_TPU_ALERT_EVAL_S": "0.25",
+                        "RAFT_TPU_ALERTS": str(alert_sink)}
     procs = {}
     results, errors = [], []
+    t_kill = None
     try:
         procs["rA"] = _spawn_replica(root, "rA", env,
                                      tmp_path / "rA.out")
@@ -708,7 +723,8 @@ def test_kill_a_replica_drill_zero_dropped(warm_bank, tmp_path):
         procs[victim] = _spawn_replica(root, victim, env,
                                        tmp_path / "rB.out")
         _wait_live(root, {"rA", victim}, procs, 300)
-        router_proc, port = _spawn_router(root, env)
+        router_proc, port = _spawn_router(root, env,
+                                          extra=router_alert_env)
         procs["router"] = router_proc
         _wait_router_replicas(port, 2, 60)
         probe = ServeClient("127.0.0.1", port, timeout=60)
@@ -788,6 +804,7 @@ def test_kill_a_replica_drill_zero_dropped(warm_bank, tmp_path):
         # ---- phase 2: SIGKILL the spar owner under 64 in-flight
         # requests — all fresh cases, so every one is a REAL dispatch
         # (a cached row would resolve before the kill even lands)
+        t_kill = time.time()   # steady state before this must be alert-free
         run_phase(2, 64, 1, case_fn=fresh_case(2), kill_after_s=0.25,
                   kill_proc=procs[victim])
         assert not errors, errors
@@ -854,6 +871,47 @@ def test_kill_a_replica_drill_zero_dropped(warm_bank, tmp_path):
              "protocol", "http_500", "http_502", "http_503"}
     assert retries and all(e.get("reason") in known for e in retries), \
         sorted({e.get("reason") for e in retries})
+    # ---- live fleet health: the SIGKILL fired breaker-storm (within
+    # an eval interval of the first breaker open), the storm RESOLVED
+    # once the replacement fleet went quiet, the steady phase fired
+    # ZERO false alerts, and the bank-consistent canary stayed green
+    from raft_tpu.obs.alerts import read_sink
+
+    records, bad = read_sink(str(alert_sink))
+    assert bad == 0
+    fires = [r for r in records if r["kind"] == "fire"]
+    assert fires, "no alert ever fired across the kill drill"
+    assert min(r["t_unix"] for r in fires) >= t_kill - 0.5, \
+        ("an alert fired during the steady phase", t_kill, fires)
+    assert {r["rule"] for r in fires} <= {"breaker-storm", "lease-churn"}, \
+        fires
+    storm = [r for r in records if r["rule"] == "breaker-storm"]
+    storm_fires = [r["t_unix"] for r in storm if r["kind"] == "fire"]
+    storm_resolves = [r["t_unix"] for r in storm if r["kind"] == "resolve"]
+    assert storm_fires, "breaker-storm never fired on the SIGKILL"
+    assert storm_resolves and min(storm_resolves) > min(storm_fires), \
+        ("breaker-storm never resolved after the replacement joined",
+         storm)
+    assert names.count("alert_fire") >= 1
+    assert names.count("alert_resolve") >= 1
+    # canary: replicas captured warmup goldens, the router probed every
+    # (replica, design) pair, and the bank-consistent fleet never
+    # tripped a parity/golden failure
+    checks = [e for e in events if e.get("event") == "canary_check"]
+    assert checks, "router canary never probed"
+    assert all(c.get("ok") for c in checks), \
+        [c for c in checks if not c.get("ok")][:3]
+    assert names.count("canary_golden") >= 2
+    # provenance stamped end to end: routed responses carried the
+    # replica's x-raft-provenance, and every stamp agreed on bank+code
+    provs = [e for e in events if e.get("event") == "router_request"
+             and e.get("provenance")]
+    assert provs, "no routed response carried a provenance stamp"
+    from raft_tpu.obs.alerts import parse_provenance
+
+    stamped = {(parse_provenance(e["provenance"]) or {}).get("bank_sha")
+               for e in provs if e.get("design") == "spar"}
+    assert len(stamped) == 1 and "none" not in stamped, stamped
     # ---- one merged timeline, zero orphan spans.  The SIGKILLed
     # victim's shard legitimately carries unmatched span BEGINS (it
     # died mid-span — that is the drill), so the strict balanced-spans
@@ -952,6 +1010,107 @@ def test_replica_fault_kinds_drive_failover(warm_bank, tmp_path):
     finally:
         _terminate_all([p for p in procs if p.poll() is None],
                        timeout=30)
+
+
+@pytest.mark.slow
+def test_canary_catches_stale_bank_provenance(warm_bank, tmp_path):
+    """A provenance-divergent replica trips ``canary_parity`` with the
+    offending provenance named in the alert payload.  The divergence
+    is injected with the deterministic ``provenance_skew`` fault (the
+    drill's stand-in for a genuinely stale-banked / env-skewed
+    replica — same bank bytes, skewed reported identity): both
+    replicas answer identical NUMBERS, so health bits, breakers and
+    the golden compare all stay green — only the cross-replica
+    provenance consistency check can see it."""
+    import urllib.request
+
+    from raft_tpu.obs.alerts import read_sink
+    from raft_tpu.serve.client import ServeClient
+
+    logdir = tmp_path / "logs"
+    logdir.mkdir()
+    root = tmp_path / "deploy"
+    alert_sink = tmp_path / "alerts.jsonl"
+    env = _fleet_env(warm_bank, logdir)
+    envB = dict(env)
+    envB["RAFT_TPU_FAULTS"] = "provenance_skew:serve_provenance:1"
+    procs = []
+    try:
+        pA = _spawn_replica(root, "rA", env, tmp_path / "rA.out")
+        procs.append(pA)
+        pB = _spawn_replica(root, "rB", envB, tmp_path / "rB.out")
+        procs.append(pB)
+        _wait_live(root, {"rA", "rB"}, {"rA": pA, "rB": pB}, 300)
+        router_proc, port = _spawn_router(
+            root, env, extra={"RAFT_TPU_CANARY_S": "0.5",
+                              "RAFT_TPU_ALERT_EVAL_S": "0.25",
+                              "RAFT_TPU_ALERTS": str(alert_sink)})
+        procs.append(router_proc)
+        _wait_router_replicas(port, 2, 60)
+
+        # the canary probes both replicas directly; within a few probe
+        # + eval periods the provenance split must be firing at /alerts
+        deadline = time.monotonic() + 60
+        payload = None
+        while time.monotonic() - deadline < 0:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/alerts", timeout=10) as r:
+                payload = json.loads(r.read())
+            active = {a["rule"] for a in payload.get("active") or ()}
+            if "canary-parity" in active:
+                break
+            time.sleep(0.5)
+        active = {a["rule"]: a for a in payload.get("active") or ()}
+        assert "canary-parity" in active, payload
+        # the alert payload names the offending provenance: the split
+        # field(s) and the per-replica values, rB carrying the skew
+        ctx = active["canary-parity"]["context"]
+        splits = ctx["provenance"]["splits"]
+        assert splits, ctx
+        by_field = {s["field"]: s for s in splits}
+        assert "bank_sha" in by_field, by_field
+        assert by_field["bank_sha"]["values"]["rB"].startswith("skew"), \
+            by_field
+        assert not by_field["bank_sha"]["values"]["rA"].startswith("skew")
+        # canary summary at the endpoint agrees
+        assert payload["canary"]["parity_ok"] is False
+        assert payload["canary"]["fails"] >= 1
+        # the sink recorded the fire with the same context
+        records, bad = read_sink(str(alert_sink))
+        assert bad == 0
+        parity = [r for r in records
+                  if r["rule"] == "canary-parity" and r["kind"] == "fire"]
+        assert parity and parity[0]["context"]["provenance"]["splits"]
+
+        # client-visible provenance through the router: the response
+        # stamp parses into last_provenance and names the replica that
+        # answered (satellite: serve/client.py last_provenance)
+        c = ServeClient("127.0.0.1", port, client_id="prov", timeout=120)
+        code, _body = c.evaluate("spar", *CASES["spar"][0])
+        assert code in (200, 422)
+        assert c.last_provenance is not None, c.last_headers
+        assert c.last_provenance["replica"] == \
+            c.last_headers.get("x-raft-replica")
+        assert {"bank_key", "bank_sha", "code", "flags"} <= \
+            set(c.last_provenance)
+        c.close()
+    finally:
+        _terminate_all([p for p in procs if p.poll() is None],
+                       timeout=30)
+    # the merged capture's report renders the INCONSISTENT provenance
+    # line and the alerts section (canary failures included)
+    events = _read_fleet_events(logdir)
+    from raft_tpu.obs.report import report_data
+
+    data = report_data(events)
+    assert data["alerts"] is not None
+    assert data["alerts"]["canary"]["provenance_failures"] >= 1
+    prov = (data["router"] or {}).get("provenance")
+    if prov is not None:
+        # the router section's consistency verdict (needs routed
+        # traffic from BOTH replicas to see the split; the canary
+        # section above is the authoritative detector)
+        assert "splits" in prov
 
 
 def test_report_router_section():
