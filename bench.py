@@ -1284,15 +1284,47 @@ def run_serve_bench(t_start=None):
                 f"tail:\n{tail}")
         lat = np.sort(np.asarray(latencies))
         n_ok = sum(1 for c in codes if c == 200)
+        p50 = float(lat[len(lat) // 2])
+        p95 = float(lat[int(len(lat) * 0.95)])
         block["load"] = dict(
             clients=n_clients, requests=len(codes), ok=n_ok,
             non_200=sorted({c for c in codes if c != 200}),
             wall_s=round(load_wall, 2),
             evals_per_s=round(n_ok / load_wall, 2),
-            p50_ms=round(float(lat[len(lat) // 2]) * 1e3, 1),
-            p95_ms=round(float(lat[int(len(lat) * 0.95)]) * 1e3, 1),
+            p50_ms=round(p50 * 1e3, 1),
+            p95_ms=round(p95 * 1e3, 1),
+            # the tail-attribution acceptance ratio (ROADMAP item 5b):
+            # BENCH_r07's fixed 20ms tick measured 4.5x
+            p95_over_p50=round(p95 / p50, 2) if p50 > 0 else None,
             max_ms=round(float(lat[-1]) * 1e3, 1),
         )
+
+        # ---- 3b. light load: sequential UNIQUE requests against the
+        # now-idle server.  Each dispatches solo, so the latency is
+        # ~(adaptive tick floor + dispatch + solve); the fixed window
+        # paid ~U(0, SERVE_TICK_MS) extra here — the light-load
+        # acceptance number of the adaptive tick
+        from raft_tpu.serve.client import ServeClient as _SC
+
+        lc = _SC("127.0.0.1", port, client_id="bench-light", timeout=600)
+        light = []
+        try:
+            for i in range(12):
+                t0 = time.perf_counter()
+                code, _body = lc.evaluate("spar", 3.1 + 0.01 * i,
+                                          8.3 + 0.05 * i, 0.21)
+                if code == 200:
+                    light.append(time.perf_counter() - t0)
+                time.sleep(0.05)   # let the queue drain to empty
+        finally:
+            lc.close()
+        if light:
+            ls = np.sort(np.asarray(light))
+            block["light_load"] = dict(
+                requests=len(light),
+                p50_ms=round(float(ls[len(ls) // 2]) * 1e3, 1),
+                max_ms=round(float(ls[-1]) * 1e3, 1),
+            )
 
         # ---- 4. server-side provenance: 0 real compiles, occupancy,
         # cache hit rate
@@ -1303,6 +1335,13 @@ def run_serve_bench(t_start=None):
         block["server"] = dict(
             programs_loaded=health.get("aot_programs_loaded"),
             programs_compiled=health.get("aot_programs_compiled"),
+            # the adaptive-tick + cost-ladder configuration actually
+            # serving (the ladder may be a pruned subset of the warmed
+            # candidates under RAFT_TPU_SERVE_LADDER=cost)
+            tick_mode=health.get("tick_mode"),
+            tick_ms=health.get("tick_ms"),
+            tick_floor_ms=health.get("tick_floor_ms"),
+            batch_sizes=health.get("batch_sizes"),
             xla_real_compiles=health.get("xla_real_compiles"),
             dispatches=health.get("serve_dispatches"),
             rows_dispatched=health.get("serve_rows_dispatched"),
@@ -1565,7 +1604,22 @@ def run_mixed(t_start):
         n_topologies=len(models),
         cold_start_compiles=clog_cold.real_count,
         padding_waste_frac=round(1.0 - s_real / s_pad, 4),
+        # per-axis decomposition under the ACTIVE pad ladder
+        # (RAFT_TPU_BUCKET_STEPS) — the strips row reproduces
+        # padding_waste_frac, nodes/lines name the rest of the budget
+        waste_by_axis=bucketing.waste_by_axis(
+            [bucketing.axis_counts(m, sigs[models.index(m)])
+             for m in models_row]),
+        bucket_steps=config.get("BUCKET_STEPS"),
     )
+    # achieved-GFLOP/s per banked program (populated when the AOT bank
+    # is armed — run the mode child under RAFT_TPU_AOT=load for the
+    # ledger-backed before/after)
+    from raft_tpu.aot import bank as _bank
+
+    ledger = _bank.ledger_summary()
+    if ledger:
+        breakdown["cost_ledger"] = ledger
     result = {
         "metric": "case-evals/sec/chip (mixed spar+semi+MHK topologies, "
                   "shape-bucketed, 40w)",

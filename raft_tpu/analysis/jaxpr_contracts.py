@@ -116,6 +116,16 @@ CONTRACTS = {
     "bucket_evaluator": Contract(
         "bucket_evaluator", dtype_clean="",
         fixed_point_modes=("while", "scan")),
+    # the FUSED rigid case evaluator (raft_tpu.api.make_case_evaluator
+    # under the default RAFT_TPU_FUSED=on): the wave response comes
+    # straight from the drag fixed point's final solve — the separable
+    # per-omega drag-excitation fold — so the staged tail's
+    # drag_excitation chain + second batched solve must NOT reappear
+    # in the trace (budget-gated: the fused trace is the smaller one,
+    # and growth back toward the staged count is the regression)
+    "fused_case": Contract(
+        "fused_case", dtype_clean="",
+        fixed_point_modes=("while", "scan")),
     # the solver-health status-assembly path (raft_tpu.utils.health +
     # the evaluators' _case_status fold): pure elementwise bit
     # arithmetic — no gathers, no host callbacks, and under the f32
@@ -251,7 +261,7 @@ class EntryPointTracer:
         with _flag_env(DTYPE=dtype, FIXED_POINT=fp or None,
                        SOLVER="native", SCAN_CHUNK=None,
                        COND_CHECK=None, COND_THRESHOLD=None,
-                       ITER_SCALE=None):
+                       ITER_SCALE=None, FUSED=None, BUCKET_STEPS=None):
             rdt, cdt = compute_dtypes(policy=dtype)
             w = jnp.asarray(model.w, dtype=rdt)
             if entry == "drag_lin_iter":
@@ -299,6 +309,13 @@ class EntryPointTracer:
                     Tp=jnp.asarray(12.0, dtype=rdt),
                     beta=jnp.asarray(0.0, dtype=rdt))
                 return jax.make_jaxpr(ev)(case)
+            if entry == "fused_case":
+                from raft_tpu.api import make_case_evaluator
+
+                # rebuilt per variant (trace-time flag closure reads)
+                ev = make_case_evaluator(model)
+                return jax.make_jaxpr(lambda p: ev(p[0], p[1], p[2]))(
+                    jnp.asarray([6.0, 12.0, 0.0], dtype=rdt))
             if entry == "health_status":
                 # the evaluators' status fold at representative shapes:
                 # statics word | dynamics word | output-finiteness and

@@ -346,6 +346,11 @@ def record_dispatch(key, wall_s):
             return
         st["dispatches"] += 1
         st["wall_s"] += wall_s
+        # best-observed wall: the noise-robust signal the serve
+        # batch-ladder tuner prefers over the mean (a single scheduler
+        # pause must not mis-shape a ladder for the server's lifetime)
+        if wall_s < st.get("wall_min_s", float("inf")):
+            st["wall_min_s"] = wall_s
         flops = st.get("flops")
         kind = st.get("kind")
     metrics.counter("program_dispatches").inc()
@@ -362,6 +367,15 @@ def record_dispatch(key, wall_s):
         kw["utilization"] = round(util, 6)
     log_event("program_dispatch", key=key, kind=kind,
               wall_s=round(wall_s, 6), **kw)
+
+
+def program_stats(key):
+    """Thread-safe snapshot of one ledgered program's running stats
+    (``{}`` when the key has never been loaded/compiled through the
+    bank) — the serve batch-ladder tuner reads measured dispatch walls
+    through this instead of touching the locked dict."""
+    with _STATS_LOCK:
+        return dict(PROGRAM_STATS.get(key) or {})
 
 
 def ledger_summary():

@@ -50,11 +50,14 @@ DEFAULT_KINDS = ("cases", "full", "design")
 #: program per bucket signature, shared by every design in the bucket —
 #: so a fresh process answers a mixed-topology sweep with zero compiles.
 #: ``serve`` warms the evaluation service's bucketed single-case
-#: programs at the batcher's padded batch-size ladder
-#: (dp,2*dp,..,RAFT_TPU_SERVE_MAX_BATCH — raft_tpu.serve.engine), so a
-#: fresh server answers its first request with zero compiles; ``--n``
-#: is ignored for this kind, set RAFT_TPU_SERVE_MAX_BATCH (and
-#: --out-keys/--x64) to EXACTLY what the server will run
+#: programs at the batcher's CANDIDATE batch-size ladder
+#: (dp,2*dp,..,RAFT_TPU_SERVE_MAX_BATCH — raft_tpu.serve.engine;
+#: under RAFT_TPU_SERVE_LADDER=cost the server prunes flat rungs after
+#: its own warmup, always to a SUBSET of these), so a fresh server
+#: answers its first request with zero compiles; ``--n`` is ignored
+#: for this kind, set RAFT_TPU_SERVE_MAX_BATCH (and --out-keys/--x64
+#: and RAFT_TPU_BUCKET_STEPS — the pad ladder is part of the bucket
+#: signature) to EXACTLY what the server will run
 ALL_KINDS = DEFAULT_KINDS + ("bucketed", "serve")
 
 _DESIGNS_DIR = os.path.join(os.path.dirname(os.path.dirname(

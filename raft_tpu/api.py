@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.models.dynamics import solve_dynamics_fowt, system_response
+from raft_tpu.models.dynamics import (fused_response_enabled,
+                                      solve_dynamics_fowt, system_response)
 from raft_tpu.models.statics_solve import solve_equilibrium
 from raft_tpu.physics import morison
 from raft_tpu.physics.mooring import mooring_stiffness
@@ -156,13 +157,16 @@ def make_design_evaluator(model):
         C_lin = jnp.asarray(K_h) + C_moor
         F_lin = exc["F_hydro_iner"][0]
 
-        Z, _, Bmat, dyn_diag = solve_dynamics_fowt(
+        Z, Xi_fused, Bmat, dyn_diag = solve_dynamics_fowt(
             fs, ss, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
             w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart,
             n_iter_extra=model.nIterExtra)
-        F_wave = exc["F_hydro_iner"][0] + morison.drag_excitation(
-            fs, ss, hc, Bmat, exc["u"][0], Tn, r_nodes)
-        Xi = system_response(Z, F_wave[None])[0]
+        if fused_response_enabled():
+            Xi = Xi_fused  # fused hot path (see models.dynamics)
+        else:
+            F_wave = exc["F_hydro_iner"][0] + morison.drag_excitation(
+                fs, ss, hc, Bmat, exc["u"][0], Tn, r_nodes)
+            Xi = system_response(Z, F_wave[None])[0]
         return dict(
             X0=X0, Xi=Xi, RAO=wv.get_rao(Xi, zeta),
             PSD=0.5 * jnp.abs(Xi) ** 2 / dw, S=S,
@@ -659,20 +663,27 @@ def make_full_evaluator(model, nWaves=1, turb_static=None, geometry=False):
         C_lin = jnp.asarray(K_h_t) + C_moor + jnp.asarray(C_elast_t)
         F_lin = F_BEM[0] + exc["F_hydro_iner"][0] + F_2nd[0]
 
-        Z, _, Bmat, dyn_diag = solve_dynamics_fowt(
+        Z, Xi_fused, Bmat, dyn_diag = solve_dynamics_fowt(
             fs, ss_t, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
             w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart,
             n_iter_extra=model.nIterExtra)
 
         # ---- per-heading responses + zero rotor-source row
         # (reference leaves the rotor excitation row zero,
-        # raft_model.py:1246-1255)
-        def fwave_one(ih):
-            F_drag = morison.drag_excitation(fs, ss_t, hc, Bmat, exc["u"][ih],
-                                             Tn, r_nodes)
-            return F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag + F_2nd[ih]
-        F_waves = jnp.stack([fwave_one(ih) for ih in range(nWaves)])
-        Xi = system_response(Z, F_waves)
+        # raft_model.py:1246-1255).  With ONE wave heading the solve's
+        # own final response is already F_lin + the drag-excitation
+        # fold (F_lin carries F_BEM[0] + F_2nd[0] too) — the fused hot
+        # path skips the staged chain; extra headings keep it (their
+        # drag excitation is heading-specific).
+        if nWaves == 1 and fused_response_enabled():
+            Xi = Xi_fused[None]
+        else:
+            def fwave_one(ih):
+                F_drag = morison.drag_excitation(fs, ss_t, hc, Bmat,
+                                                 exc["u"][ih], Tn, r_nodes)
+                return F_BEM[ih] + exc["F_hydro_iner"][ih] + F_drag + F_2nd[ih]
+            F_waves = jnp.stack([fwave_one(ih) for ih in range(nWaves)])
+            Xi = system_response(Z, F_waves)
         Xi = jnp.concatenate([Xi, jnp.zeros((1, nDOF, nw), dtype=Xi.dtype)])
 
         # ---- mean-drift fed back into the equilibrium for the reported
@@ -1186,10 +1197,16 @@ def make_case_evaluator(model, n_stat_iter=12):
             w, Tn, r_nodes, n_iter=model.nIter, Xi_start=model.XiStart,
             n_iter_extra=model.nIterExtra,
         )
-        F_wave = F_lin * 0 + exc["F_hydro_iner"][0] + morison.drag_excitation(
-            fs, ss, hc, Bmat, exc["u"][0], Tn, r_nodes
-        )
-        Xi = system_response(Z, F_wave[None])[0]  # (nDOF, nw)
+        if fused_response_enabled():
+            # fused hot path: the solve's final response is already
+            # F_lin + the separable drag-excitation fold — skip the
+            # staged drag_excitation chain + second system solve
+            Xi = Xi1  # (nDOF, nw)
+        else:
+            F_wave = F_lin * 0 + exc["F_hydro_iner"][0] + morison.drag_excitation(
+                fs, ss, hc, Bmat, exc["u"][0], Tn, r_nodes
+            )
+            Xi = system_response(Z, F_wave[None])[0]  # (nDOF, nw)
 
         RAO = wv.get_rao(Xi, zeta)
         PSD = 0.5 * jnp.abs(Xi) ** 2 / dw

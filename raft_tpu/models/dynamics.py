@@ -54,6 +54,28 @@ def impedance(w, M, B, C):
     return (-(w**2)[:, None, None] * Mw + 1j * w[:, None, None] * Bw + C[None, :, :])
 
 
+def fused_response_enabled():
+    """True when the rigid single-heading evaluators should take their
+    wave response straight from :func:`solve_dynamics_fowt`'s returned
+    ``Xi`` — the fused case hot path (``RAFT_TPU_FUSED``, trace-time).
+
+    The fixed point's final ``update(XiLast)`` already solves
+    ``Z xi = F_lin + F_drag`` with ``F_drag`` assembled through the
+    separable per-ω drag-excitation fold of
+    :func:`raft_tpu.physics.morison.drag_lin_precompute` (three
+    ``(S, nDOF) x (c_d * proj_d)`` contractions).  The staged tail the
+    evaluators used to run — :func:`raft_tpu.physics.morison.
+    drag_excitation` (the full ``Bmat @ u`` / moment / segment-sum /
+    T-reduction chain) followed by a second :func:`system_response`
+    solve — recomputes the algebraically identical quantity, so fusing
+    drops one full batched complex solve plus the whole staged
+    excitation chain per case.  Fold-vs-chain summation order differs
+    at the last few ulps: parity vs the staged path is gated at 1e-10
+    with bit-equal status (tests/test_fused.py); ``RAFT_TPU_FUSED=off``
+    restores the staged tail as the parity oracle."""
+    return config.get("FUSED") == "on"
+
+
 def fixed_point_mode():
     """Fixed-point loop driver: 'scan', 'while', or the default 'auto'
     (``RAFT_TPU_FIXED_POINT`` flag, read at trace time).

@@ -168,7 +168,13 @@ def default_rules():
     """The default rule pack: the fleet's known failure classes, each
     grounded in a metric an earlier PR already records.  Thresholds
     are deliberately conservative — steady state on a healthy fleet
-    fires nothing (drill-asserted)."""
+    fires nothing (drill-asserted).  Re-checked against the adaptive
+    serve tick + cost-pruned batch ladder: light-load requests now
+    dispatch solo (lower occupancy, MORE dispatches), which moves no
+    rule input — cache keys, compile counts and breaker/lease signals
+    are all window-independent, and `cache-hit-collapse` gates on the
+    0.05 floor precisely so legitimate low-dup workloads (every light-
+    load probe is a distinct case) cannot page anyone."""
     return [
         Rule("slo-breach", "counter:serve_slo_breaches", "rate_above",
              threshold=0.1, for_s=5.0, clear_s=30.0, severity="warning",

@@ -221,6 +221,12 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
     "serve_stop": (
         ("requests", "wall_s"),
         "the service exited after draining and flushing metrics"),
+    "serve_ladder": (
+        ("candidates", "sizes", "walls_ms"),
+        "cost-driven batch-ladder refinement (RAFT_TPU_SERVE_LADDER="
+        "cost): candidate rungs whose measured per-dispatch wall was "
+        "flat vs the next rung were pruned after warmup — `sizes` is "
+        "the serving ladder, every rung of it warmed"),
     # ------------------------------------------------------ serving fleet
     "replica_join": (
         ("replica", "port", "designs", "root"),

@@ -483,6 +483,12 @@ def report_data(events, n_bad=0, source="<events>"):
             "dispatches": sum(e.get("dispatches") or 0 for e in ticks),
             "mean_batch": round(sum(rows) / len(ticks), 2),
             "p95_s": _percentile(walls, 0.95)}
+        # cost-driven ladder refinement, when the capture recorded one
+        ladder_evs = [e for e in events if e["event"] == "serve_ladder"]
+        if ladder_evs:
+            tick_summary["ladder"] = {
+                "candidates": ladder_evs[-1].get("candidates"),
+                "sizes": ladder_evs[-1].get("sizes")}
 
     # device-cost ledger: one row per banked/compiled program, joined
     # from program_cost (flops, at load/store) and program_dispatch
@@ -686,6 +692,10 @@ def render_report(events, n_bad=0, source="<events>"):
                 f"{t['dispatches']} dispatches; "
                 f"mean batch {t['mean_batch']:.1f}, "
                 f"tick p95 {t['p95_s']:.3f}s)")
+            if t.get("ladder"):
+                out.append(
+                    f"  batch ladder: {t['ladder']['sizes']} "
+                    f"(cost-pruned from {t['ladder']['candidates']})")
 
     router = data["router"]
     if router:

@@ -379,6 +379,14 @@ WATCH_RULES = (
     ("counter:shards_corrupt", "lower", 0.5, 0.5),
     ("counter:shard_retries", "lower", 0.5, 1.5),
     ("counter:shard_oom_splits", "lower", 0.5, 0.5),
+    # per-TICK wall is a scheduling-policy metric, not a latency SLO:
+    # the adaptive tick (PR 15) makes it bimodal BY DESIGN (near-empty
+    # floor-window ticks vs dispatching ticks), so its percentiles
+    # straddle log-bucket boundaries and flap on clean reruns.  The
+    # request-level gates below (serve_request*/window/stage) are the
+    # user-facing latency contract; tick walls stay informational.
+    ("hist:serve_tick_s:*", "none", None, 0.0),
+    ("hist:span_serve_tick_s:*", "none", None, 0.0),
     # latency-like: every *_s histogram/window/stage percentile
     ("hist:*_s:p50", "lower", 1.0, 0.02),
     ("hist:*_s:p95", "lower", 1.0, 0.05),
@@ -392,10 +400,12 @@ WATCH_RULES = (
 
 def watch_rule(name):
     """``(better, rel_tol | None, abs_floor)`` of the first matching
-    rule, or None."""
+    rule, or None.  A rule with ``better="none"`` EXEMPTS its metrics:
+    first-match-wins, so it shields them from a later catch-all
+    pattern (informational — compared, never gated)."""
     for pattern, better, rel, floor in WATCH_RULES:
         if fnmatch.fnmatchcase(name, pattern):
-            return better, rel, floor
+            return None if better == "none" else (better, rel, floor)
     return None
 
 

@@ -49,7 +49,7 @@ def _flags_key():
 
     return tuple(config.get(k) for k in
                  ("SOLVER", "FIXED_POINT", "SCAN_CHUNK", "DTYPE",
-                  "COND_CHECK", "COND_THRESHOLD", "ITER_SCALE"))
+                  "COND_CHECK", "COND_THRESHOLD", "ITER_SCALE", "FUSED"))
 
 
 def _cached_jit(evaluate, key, build):

@@ -145,6 +145,18 @@ def _serve_main(argv):
         print(f"warmup: {len(reports)} program(s) "
               f"({loaded} bank-loaded, {compiled} compiled) in {wall:.1f}s",
               flush=True)
+        # cost-driven ladder refinement (RAFT_TPU_SERVE_LADDER=cost):
+        # the warmup dispatches just measured every candidate rung's
+        # wall through the cost ledger — prune the flat rungs so the
+        # serving ladder only keeps rungs that buy latency (every kept
+        # rung was warmed above; a no-warm server keeps the candidates)
+        refined = engine.refine_ladder(
+            [registry.get(n) for n in registry.names()],
+            batcher.sizes, mesh=batcher.mesh, out_keys=batcher.out_keys)
+        if tuple(refined) != tuple(batcher.sizes):
+            print(f"batch ladder refined {list(batcher.sizes)} -> "
+                  f"{list(refined)} (cost-flat rungs pruned)", flush=True)
+            batcher.set_sizes(refined)
 
     # the replica id is fixed BEFORE the server starts: the provenance
     # stamp and the fleet lease must name the same replica

@@ -2,14 +2,27 @@
 
 Socket-free and unit-testable: requests go in through :meth:`Batcher.
 submit` (thread-safe, returns a ``concurrent.futures.Future``), pend in
-one bounded admission queue, and every ``RAFT_TPU_SERVE_TICK_MS`` the
-dispatcher coalesces the backlog — deduplicating identical in-flight
-cases, grouping the rest by bucket signature so MIXED-TOPOLOGY tenants
-share one compiled program, padding each group to the fixed batch
-ladder — into the bucketed evaluators, then fans the results back out
-per request.  This is inference-server-style continuous batching over
-the *design* axis: the batch dimension is "whichever tenants are
-waiting right now", not a precomputed sweep.
+one bounded admission queue, and each tick the dispatcher coalesces
+the backlog — deduplicating identical in-flight cases, grouping the
+rest by bucket signature so MIXED-TOPOLOGY tenants share one compiled
+program, padding each group to the batch ladder — into the bucketed
+evaluators, then fans the results back out per request.  This is
+inference-server-style continuous batching over the *design* axis: the
+batch dimension is "whichever tenants are waiting right now", not a
+precomputed sweep.
+
+The coalescing window is ADAPTIVE by default (ROADMAP item 5b,
+``RAFT_TPU_SERVE_TICK_MODE``): it anchors on the oldest pending
+request and scales with the recent per-tick row load between
+``RAFT_TPU_SERVE_TICK_MIN_MS`` (near-empty queue — the whole window is
+pure tail latency, so a lone light-load request waits ~the floor
+instead of the full tick) and the ``RAFT_TPU_SERVE_TICK_MS`` ceiling
+(sustained load — bigger batches amortize the wait), and a bucket
+group filling a full top ladder rung dispatches speculatively early.
+The PR-11 stage decomposition (queue_wait/tick_wait/dispatch/solve/
+post) is computed from the same submit/tick/dispatch marks, so the
+stages keep summing to the measured total by construction whatever the
+window does.
 
 Error semantics ride in-band: every row carries the int32 solver-health
 ``status`` word (:mod:`raft_tpu.utils.health`); SEVERE bits surface in
@@ -21,7 +34,13 @@ adoption rule.
 
 Healthy rows land in the content-addressed result cache
 (:mod:`raft_tpu.serve.cache`); a submit-time hit resolves the future
-without ever queueing.  Backpressure: per-client token buckets raise
+without ever queueing.  Between miss and cache insert the case is
+IN-FLIGHT: a duplicate submitted while its row is mid-solve joins the
+solving tick's requester list (cross-tick joining,
+``serve_inflight_joins``) instead of dispatching the same case again —
+under a cold burst this removes the redundant re-solves that used to
+stretch the tail (BENCH_r07 measured ~140 of 232 dispatched rows
+redundant under the 200-client load).  Backpressure: per-client token buckets raise
 :class:`QuotaExceeded` (→ 429), a full admission queue raises
 :class:`QueueFull` (→ 503), a draining service raises
 :class:`Draining` (→ 503).
@@ -122,6 +141,13 @@ class Batcher:
         self.sizes = engine.batch_ladder(self.mesh, max_batch)
         self.tick_s = (float(config.get("SERVE_TICK_MS"))
                        if tick_ms is None else float(tick_ms)) / 1e3
+        # adaptive tick (ROADMAP item 5b): the coalescing window scales
+        # between the floor and self.tick_s with the recent per-tick
+        # row load, and a bucket group filling a top ladder rung
+        # dispatches speculatively early — see _wake_in
+        self.tick_mode = str(config.get("SERVE_TICK_MODE"))
+        self.tick_floor_s = min(
+            float(config.get("SERVE_TICK_MIN_MS")) / 1e3, self.tick_s)
         self.cache = cache if cache is not None else ResultCache(
             int(float(config.get("SERVE_CACHE_MB")) * 1e6))
         self.quotas = quotas if quotas is not None else ClientQuotas(
@@ -133,6 +159,24 @@ class Batcher:
         self._draining = False  # raft-lint: guarded-by=self._cond
         self._stop = False  # raft-lint: guarded-by=self._cond
         self._in_tick = False  # raft-lint: guarded-by=self._cond
+        # adaptive-tick state: per-signature pending UNIQUE cache keys
+        # (the full-rung early-dispatch trigger — duplicates of one
+        # case dedupe to a single dispatched row, so counting requests
+        # would collapse the window for a 1-row batch under a same-
+        # corner burst), the oldest pending request's submit instant
+        # (the window anchors on it), and an EMA of dispatched UNIQUE
+        # rows per tick (the load signal the window scales with)
+        self._sig_pending: dict = {}  # raft-lint: guarded-by=self._cond
+        self._first_pending_t = None  # raft-lint: guarded-by=self._cond
+        self._load_ema = 0.0  # raft-lint: guarded-by=self._cond
+        # cross-tick in-flight joining: cache_key -> the requester list
+        # of a row some tick is CURRENTLY solving.  A duplicate case
+        # submitted mid-solve attaches to that list instead of queueing
+        # a redundant dispatch row (the burst pattern: hundreds of
+        # clients posting the same corner before the first result can
+        # reach the cache) — the dispatching tick pops the (grown)
+        # list when its row lands and fans out to every joiner.
+        self._inflight: dict = {}  # raft-lint: guarded-by=self._cond
         self._thread = None
 
     # ------------------------------------------------------------ submit
@@ -179,6 +223,15 @@ class Batcher:
             if self._draining:
                 bucket.refund()   # rejected work must not eat quota
                 raise Draining("service is draining")
+            joined = self._inflight.get(key)
+            if joined is not None:
+                # the same case is mid-solve in an earlier tick: ride
+                # its row instead of dispatching it again (cache-miss
+                # only because the result is not back yet)
+                joined.append(req)
+                metrics.counter("serve_coalesced").inc()
+                metrics.counter("serve_inflight_joins").inc()
+                return req.future
             if len(self._pending) >= self.queue_bound:
                 bucket.refund()
                 metrics.counter("serve_rejected_queue").inc()
@@ -188,10 +241,24 @@ class Batcher:
                     f"admission queue full ({self.queue_bound} pending)")
             self._pending.append(req)
             metrics.gauge("serve_pending").set(len(self._pending))
-            # deliberately NO notify: the dispatcher wakes on its tick
-            # cadence, and that sleep IS the coalescing window — waking
-            # it per submit would dispatch every lull-time request as a
-            # batch of one (only drain() wakes it out of cadence)
+            keys = self._sig_pending.setdefault(entry.sig, set())
+            keys.add(key)
+            n_sig = len(keys)
+            if self._first_pending_t is None:
+                self._first_pending_t = req.t_submit
+            # the tick sleep IS the coalescing window, so a fixed-mode
+            # submit never notifies (waking per submit would dispatch
+            # every lull-time request as a batch of one — the PR-9
+            # lesson).  Adaptive mode wakes the dispatcher only when
+            # the window itself should move: the queue just went
+            # empty->nonempty (the dispatcher may be parked on the
+            # idle ceiling; _wake_in re-anchors on this request, so a
+            # lone light-load request waits ~the floor, not the full
+            # tick) or a bucket group just filled the top ladder rung
+            # (a full batch gains nothing by waiting — dispatch NOW)
+            if self.tick_mode == "adaptive" and (
+                    len(self._pending) == 1 or n_sig >= self.sizes[-1]):
+                self._cond.notify_all()
         return req.future
 
     # -------------------------------------------------------------- tick
@@ -203,10 +270,15 @@ class Batcher:
         with self._cond:
             batch = list(self._pending)
             self._pending.clear()
+            self._sig_pending.clear()
+            self._first_pending_t = None
             metrics.gauge("serve_pending").set(0)
             self._in_tick = True
         if not batch:
             with self._cond:
+                # idle ticks decay the load signal so the first lone
+                # request after a burst gets the floor window again
+                self._load_ema *= 0.7
                 self._in_tick = False
                 self._cond.notify_all()
             return 0
@@ -219,6 +291,16 @@ class Batcher:
         for req in batch:
             unique.setdefault(req.cache_key, []).append(req)
         metrics.counter("serve_coalesced").inc(len(batch) - len(unique))
+        with self._cond:
+            # publish the requester lists for cross-tick joining: a
+            # duplicate case submitted while its row is mid-solve
+            # appends itself to the SAME list (under this lock) and is
+            # fanned out when the dispatching chunk pops the key
+            for key_, rl in unique.items():
+                self._inflight[key_] = rl
+            # load EMA over UNIQUE dispatched rows (0.3 smoothing):
+            # a duplicate-heavy burst must not read as a full device
+            self._load_ema += 0.3 * (len(unique) - self._load_ema)
         groups: dict = {}
         for reqs in unique.values():
             groups.setdefault(reqs[0].entry.sig, []).append(reqs)
@@ -284,6 +366,12 @@ class Batcher:
                     log_event("serve_error", error=repr(e)[:300],
                               rows=len(chunk))
                     metrics.counter("serve_errors").inc()
+                    # retire the in-flight keys FIRST so late joiners
+                    # re-queue for a fresh tick instead of attaching to
+                    # a list nobody will resolve again
+                    with self._cond:
+                        for rl in chunk:
+                            self._inflight.pop(rl[0].cache_key, None)
                     for rl in chunk:
                         for req in rl:
                             if not req.future.set_running_or_notify_cancel():
@@ -295,6 +383,11 @@ class Batcher:
                 marks = (tick_t0, t_d0, t_d1, solve_s)
                 for i, rl in enumerate(chunk):
                     row = {k: out[k][i] for k in self.out_keys}
+                    # retire the in-flight key before fan-out: joiners
+                    # appended up to this instant ride this row; later
+                    # submits hit the result cache (or the next tick)
+                    with self._cond:
+                        self._inflight.pop(rl[0].cache_key, None)
                     for req in rl:
                         req.t_marks = marks
                     if self._needs_escalation(rl, row):
@@ -351,13 +444,17 @@ class Batcher:
             return  # requester went away (client timeout/cancel)
         wall = time.perf_counter() - req.t_submit
         metrics.histogram("serve_request_s").observe(wall)
-        if req.t_marks is not None and not cache_hit:
+        if req.t_marks is not None and not cache_hit \
+                and req.t_submit <= req.t_marks[0]:
             # tail attribution: split this request's end-to-end latency
             # into named stages that sum to `wall` by construction —
             # queue_wait (pending until its tick began), tick_wait
             # (behind earlier groups inside the tick), dispatch
             # (pack/device_put overhead), solve (compiled program +
-            # fetch), post (status fold / cache insert / escalation)
+            # fetch), post (status fold / cache insert / escalation).
+            # A cross-tick JOINER (submitted after its row's tick began)
+            # is excluded: the tick-level stage windows started before
+            # it existed, so they cannot decompose ITS wall
             tick_t0, d0, d1, solve_s = req.t_marks
             stages = {
                 "queue_wait": max(tick_t0 - req.t_submit, 0.0),
@@ -401,9 +498,53 @@ class Batcher:
             with self._cond:
                 if self._stop and not self._pending:
                     return
-                delay = self.tick_s - (time.perf_counter() - t0)
-                if delay > 0 and not self._stop:
+                while not self._stop:
+                    delay = self._wake_in(t0)
+                    if delay <= 0:
+                        break
+                    # a submit may notify (adaptive wake conditions) —
+                    # re-evaluate the window rather than trusting the
+                    # original timeout
                     self._cond.wait(timeout=delay)
+
+    def _wake_in(self, tick_t0):
+        """Seconds until the next tick should run (call under _cond).
+
+        Fixed mode: the constant ``SERVE_TICK_MS`` cadence.  Adaptive
+        mode (ROADMAP item 5b): a bucket group at a full top ladder
+        rung dispatches NOW (a full batch gains nothing by waiting);
+        an empty queue parks on the ceiling (a submit notifies and
+        re-anchors); otherwise the coalescing window anchors on the
+        OLDEST pending request and scales with the recent per-tick row
+        load between the floor (near-empty queue: the whole window is
+        pure tail latency) and the ceiling (sustained load: bigger
+        batches amortize the wait) — capped by the fixed cadence so a
+        busy server never ticks slower than before."""
+        now = time.perf_counter()
+        deadline = tick_t0 + self.tick_s
+        if self.tick_mode != "adaptive":
+            return deadline - now
+        if self._sig_pending and max(
+                len(ks) for ks in self._sig_pending.values()) \
+                >= self.sizes[-1]:
+            return 0.0
+        if self._first_pending_t is None:
+            return deadline - now
+        frac = min(1.0, self._load_ema / max(self.sizes[-1], 1))
+        window = self.tick_floor_s + frac * (self.tick_s - self.tick_floor_s)
+        return min(deadline, self._first_pending_t + window) - now
+
+    def set_sizes(self, sizes):
+        """Swap the batch ladder (post-warmup cost refinement,
+        :func:`raft_tpu.serve.engine.refine_ladder`).  Every rung must
+        already be warmed — the batcher only ever dispatches ladder
+        sizes, so a pruned ladder keeps the compile-free contract."""
+        sizes = tuple(sorted(int(s) for s in sizes))
+        if not sizes:
+            raise ValueError("empty batch ladder")
+        with self._cond:
+            self.sizes = sizes
+        return self.sizes
 
     @property
     def draining(self):
@@ -437,8 +578,12 @@ class Batcher:
     def stats(self):
         return {
             "pending": len(self._pending),
+            "inflight_rows": len(self._inflight),
             "draining": self._draining,
             "tick_ms": self.tick_s * 1e3,
+            "tick_mode": self.tick_mode,
+            "tick_floor_ms": self.tick_floor_s * 1e3,
+            "load_ema_rows": round(self._load_ema, 2),
             "batch_sizes": list(self.sizes),
             "out_keys": list(self.out_keys),
             "designs": self.registry.names(),

@@ -11,12 +11,18 @@ heterogeneous sweep at the same batch size) is THE program a serving
 tick loads, so a warmed fresh server answers its first request with
 zero backend compilations.
 
-Batch sizes are a fixed pow2 **ladder** (``dp``-based:
-``dp, 2*dp, 4*dp, ... <= RAFT_TPU_SERVE_MAX_BATCH``): every dispatch
-pads its rows up to the next ladder size with masked repeat rows
-(dropped on fan-out), so arbitrary tick occupancies reuse a handful of
-compiled programs instead of minting one per pending count.  The
-ladder is exactly what the ``serve`` warmup kind warms.
+Batch sizes are a **ladder**: every dispatch pads its rows up to the
+next ladder size with masked repeat rows (dropped on fan-out), so
+arbitrary tick occupancies reuse a handful of compiled programs
+instead of minting one per pending count.  Rung selection is
+cost-driven by default (``RAFT_TPU_SERVE_LADDER=cost``): the pow2
+candidates ``dp, 2*dp, ... <= RAFT_TPU_SERVE_MAX_BATCH`` are warmed,
+then :func:`refine_ladder` prunes the rungs whose measured dispatch
+wall is flat vs the next rung (fixed overhead floor / under-utilized
+device: padding up is free there) and keeps the rungs where the wall
+scales (padding costs real time: finer rungs win).  The candidate set
+is exactly what the ``serve`` warmup kind warms, so a pruned ladder
+only ever dispatches warmed programs.
 """
 
 from __future__ import annotations
@@ -137,17 +143,124 @@ class Registry:
 # --------------------------------------------------------------- dispatch
 
 
-def batch_ladder(mesh, max_batch=None):
-    """The fixed padded batch sizes the service dispatches (and the
-    ``serve`` warmup kind warms): ``dp, 2*dp, ...`` up to
-    ``RAFT_TPU_SERVE_MAX_BATCH`` (at least one rung)."""
+def batch_ladder(mesh, max_batch=None, policy=None):
+    """The padded batch sizes the service dispatches (and the ``serve``
+    warmup kind warms), per ``RAFT_TPU_SERVE_LADDER``:
+
+    * ``pow2`` — ``dp, 2*dp, ...`` up to ``RAFT_TPU_SERVE_MAX_BATCH``
+      (at least one rung): the legacy blind ladder;
+    * ``cost`` (default) — the same pow2 CANDIDATES here; after warmup
+      has measured every rung's dispatch wall through the cost ledger,
+      :func:`refine_ladder` prunes the rungs whose wall is flat vs the
+      next rung (dispatching padded bigger costs ~nothing there, so
+      the extra program bought nothing but warmup/bank bill);
+    * an explicit ascending comma list (e.g. ``1,4,16,64``) — rungs
+      used verbatim (each must divide by the mesh's dp axis).
+    """
     dp = mesh.shape.get("dp", 1)
     if max_batch is None:
         max_batch = int(config.get("SERVE_MAX_BATCH"))
-    sizes = [dp]
-    while sizes[-1] * 2 <= max(max_batch, dp):
-        sizes.append(sizes[-1] * 2)
-    return tuple(sizes)
+    if policy is None:
+        policy = str(config.get("SERVE_LADDER") or "cost").strip().lower()
+    if policy in ("pow2", "cost"):
+        sizes = [dp]
+        while sizes[-1] * 2 <= max(max_batch, dp):
+            sizes.append(sizes[-1] * 2)
+        return tuple(sizes)
+    try:
+        sizes = tuple(int(s) for s in policy.split(",") if s.strip())
+    except ValueError:
+        raise ValueError(
+            f"RAFT_TPU_SERVE_LADDER={policy!r}: expected 'pow2', 'cost' "
+            "or an ascending comma list of rung sizes")
+    if not sizes or any(b <= a for a, b in zip(sizes, sizes[1:])) or \
+            any(s < dp or s % dp for s in sizes):
+        raise ValueError(
+            f"RAFT_TPU_SERVE_LADDER={policy!r}: rungs must be strictly "
+            f"ascending multiples of the dp axis size ({dp})")
+    return sizes
+
+
+def prune_ladder(sizes, walls, tol=None):
+    """Cost-driven rung selection: keep a rung only where it measurably
+    saves dispatch wall over the next kept rung.
+
+    ``walls`` maps rung -> measured mean seconds per dispatch (missing
+    rungs are kept — never prune on ignorance).  Walking from the top
+    rung (always kept: it is the tick's chunk cap) downward, rung ``r``
+    survives only if ``wall(next_kept) > tol * wall(r)`` — i.e. padding
+    ``r``'s occupancy up to the next kept rung would cost real time
+    (padding dominates there: finer rungs).  Where the wall is flat
+    (fixed dispatch overhead floor, under-utilized device) the rung is
+    dropped: fewer programs to warm/bank, identical latency."""
+    if tol is None:
+        tol = float(config.get("SERVE_LADDER_TOL"))
+    sizes = sorted(sizes)
+    keep = [sizes[-1]]
+    for r in reversed(sizes[:-1]):
+        w_r, w_next = walls.get(r), walls.get(keep[-1])
+        if w_r is None or w_next is None or w_next > tol * w_r:
+            keep.append(r)
+    return tuple(sorted(keep))
+
+
+def ladder_walls(entries, sizes, mesh=None, out_keys=DEFAULT_OUT_KEYS):
+    """Measured dispatch wall per ladder rung, from the in-process
+    cost ledger (:data:`raft_tpu.aot.bank.PROGRAM_STATS` — populated by
+    the warmup dispatches / prior serving load of a bank-routed
+    process).  Per program the BEST observed wall (``wall_min_s``) is
+    preferred over the mean — one scheduler hiccup during a warmup
+    dispatch must not mis-shape the ladder for the server's lifetime,
+    which is also why :func:`warm` dispatches every rung twice.  Each
+    rung then reports the WORST of that across the served bucket
+    signatures, so a rung is only ever pruned when it is flat for
+    every tenant.  Rungs nothing has measured map to None."""
+    from raft_tpu.aot import bank
+    from raft_tpu.parallel.sweep import make_mesh
+
+    if mesh is None:
+        mesh = make_mesh()
+    out_keys = normalize_out_keys(out_keys)
+    by_sig = {}
+    for e in entries:
+        by_sig.setdefault(e.sig, e)
+    walls = {}
+    for rung in sizes:
+        worst = None
+        for e in by_sig.values():
+            try:
+                key, _ = program_identity(e, mesh=mesh, out_keys=out_keys,
+                                          rows=rung)
+            except Exception:  # noqa: BLE001 — ladder tuning is telemetry
+                continue
+            st = bank.program_stats(key)
+            if st.get("dispatches") and st.get("wall_s", 0) > 0:
+                w = st.get("wall_min_s") or (st["wall_s"]
+                                             / st["dispatches"])
+                worst = w if worst is None else max(worst, w)
+        walls[rung] = worst
+    return walls
+
+
+def refine_ladder(entries, sizes, mesh=None, out_keys=DEFAULT_OUT_KEYS):
+    """Post-warmup ladder refinement (``RAFT_TPU_SERVE_LADDER=cost``):
+    prune the warmed candidate rungs whose measured dispatch wall is
+    flat vs the next rung.  Under any other policy — or with no
+    measurements (e.g. ``RAFT_TPU_AOT=off``, where dispatches are not
+    cost-ledgered) — the candidates come back unchanged.  Every
+    returned rung was warmed (pruning only ever drops rungs), so the
+    steady-state zero-recompile contract is untouched."""
+    policy = str(config.get("SERVE_LADDER") or "cost").strip().lower()
+    if policy != "cost" or len(sizes) <= 1:
+        return tuple(sizes)
+    walls = ladder_walls(entries, sizes, mesh=mesh, out_keys=out_keys)
+    pruned = prune_ladder(sizes, walls)
+    if tuple(pruned) != tuple(sizes):
+        log_event("serve_ladder", candidates=list(sizes),
+                  sizes=list(pruned),
+                  walls_ms={str(r): (round(w * 1e3, 3) if w else None)
+                            for r, w in walls.items()})
+    return pruned
 
 
 def pick_padded(n, sizes):
@@ -413,6 +526,16 @@ def warm(entries, mesh=None, out_keys=DEFAULT_OUT_KEYS, sizes=None):
                            out_keys=out_keys, mesh=mesh, padded=rows,
                            record_metrics=False)
             jax.block_until_ready(out)
+            # a second, execution-only dispatch: the cost-ladder tuner
+            # reads the BEST wall per rung, and one sample (possibly
+            # fattened by post-load lazy init or a scheduler pause)
+            # must not shape the serving ladder
+            jax.block_until_ready(
+                dispatch(row_entries, rng.uniform(2.0, 8.0, rows),
+                         rng.uniform(6.0, 14.0, rows),
+                         rng.uniform(-0.5, 0.5, rows),
+                         out_keys=out_keys, mesh=mesh, padded=rows,
+                         record_metrics=False))
             rep = dict(
                 kind="serve", rows=rows,
                 bucket=bucketing.signature_fingerprint(sig),

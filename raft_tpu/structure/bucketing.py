@@ -9,8 +9,10 @@ compilation serves all 10k designs" claim (SURVEY §7.1) exists to kill.
 This module makes the *design itself* a traced input.  Every per-design
 quantity the rigid-body case-evaluation chain consumes is extracted
 into a flat pytree of fixed-shape arrays, padded up to per-family
-**shape buckets** (powers of two over the strip / node / mooring-line
-axes) with explicit validity masks:
+**shape buckets** (per-axis pad ladders over the strip / node /
+mooring-line axes — measured-waste-tuned by default, configurable via
+``RAFT_TPU_BUCKET_STEPS``; see :func:`pad_ladder`) with explicit
+validity masks:
 
 * padded STRIPS carry zero areas, zero drag/added-mass coefficients and
   a False entry in ``strip_mask``/``active``, so they contribute
@@ -61,7 +63,8 @@ from raft_tpu.physics.mooring import MooringSystem, catenary_line_forces
 BUCKET_VERSION = 1
 
 #: minimum bucket sizes: small designs share one family instead of
-#: minting near-empty micro-buckets
+#: minting near-empty micro-buckets (the floors of the pow2 policy and
+#: of every ladder's doubling continuation — see :func:`pad_ladder`)
 STRIP_FLOOR = 16
 NODE_FLOOR = 2
 LINE_FLOOR = 2
@@ -74,6 +77,113 @@ class UnbucketableDesignError(ValueError):
 def _ceil_pow2(n, floor=1):
     n = max(int(n), int(floor))
     return 1 << (n - 1).bit_length()
+
+
+# ------------------------------------------------------------ pad ladders
+
+#: per-axis floor of the 'pow2' ladder policy (and of any explicit
+#: ladder's doubling continuation)
+AXIS_FLOORS = {"strips": STRIP_FLOOR, "nodes": NODE_FLOOR,
+               "lines": LINE_FLOOR}
+
+
+def pad_ladder(spec=None):
+    """Parse the ``RAFT_TPU_BUCKET_STEPS`` pad-ladder spec.
+
+    ``spec`` is ``';'``-separated ``axis=rungs`` entries (axes
+    ``strips``/``nodes``/``lines``); ``rungs`` is either the literal
+    ``pow2`` (classic power-of-two ceiling at the axis floor) or an
+    ascending comma list of explicit rung sizes — beyond the last rung
+    the ladder continues by doubling, so no design is ever too big.
+    Returns ``{axis: tuple(rungs) | None}`` (``None`` = pow2).
+
+    The default ladder is measured-waste-tuned (ROADMAP item 5a): the
+    PR-11 row-weighted ``waste_by_axis`` histograms put essentially the
+    whole pad budget on the STRIPS axis (each strip row drags a
+    ``(S, 3, 3, nw)`` complex ``Imat`` through the whole case chain),
+    with per-row pad fractions clustered just under the pow2 ceilings
+    — so strips get midpoint rungs between the pow2 sizes (worst-case
+    waste 1/3 instead of 1/2; bundled-trio row-weighted waste 0.35 →
+    0.15), while the cheap nodes/lines axes keep coarse pow2 rungs
+    (fewer distinct signatures = more program sharing).
+    """
+    from raft_tpu.utils import config
+
+    spec = config.get("BUCKET_STEPS") if spec is None else spec
+    ladders = dict.fromkeys(AXIS_FLOORS)
+    if not spec or spec.strip().lower() == "pow2":
+        return ladders
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        axis, sep, rungs = part.partition("=")
+        axis = axis.strip().lower()
+        if not sep or axis not in AXIS_FLOORS:
+            raise ValueError(
+                f"RAFT_TPU_BUCKET_STEPS entry {part!r}: expected "
+                f"axis=rungs with axis one of {sorted(AXIS_FLOORS)}")
+        rungs = rungs.strip().lower()
+        if rungs == "pow2":
+            ladders[axis] = None
+            continue
+        try:
+            sizes = tuple(int(r) for r in rungs.split(",") if r.strip())
+        except ValueError:
+            raise ValueError(
+                f"RAFT_TPU_BUCKET_STEPS {axis} rungs {rungs!r}: expected "
+                "'pow2' or a comma list of integers")
+        if not sizes or any(s <= 0 for s in sizes) or \
+                any(b <= a for a, b in zip(sizes, sizes[1:])):
+            raise ValueError(
+                f"RAFT_TPU_BUCKET_STEPS {axis} rungs {rungs!r}: rungs "
+                "must be positive and strictly ascending")
+        ladders[axis] = sizes
+    return ladders
+
+
+def _axis_pad(n, axis, ladders=None):
+    """Padded size of ``n`` real rows on ``axis`` under the active
+    ladder: the smallest rung holding ``n`` (doubling past the last
+    explicit rung; pow2-at-floor when the axis has no explicit rungs).
+    ``n == 0`` stays 0 (axis absent, e.g. a moorings-free design)."""
+    n = int(n)
+    if n <= 0:
+        return 0
+    ladders = pad_ladder() if ladders is None else ladders
+    rungs = ladders.get(axis)
+    if rungs is None:
+        return _ceil_pow2(n, AXIS_FLOORS[axis])
+    for r in rungs:
+        if r >= n:
+            return r
+    r = rungs[-1]
+    while r < n:
+        r *= 2
+    return r
+
+
+def tuned_rungs(observed_sizes, max_waste=0.2, floor=None):
+    """Seed a ladder from measured axis occupancy (the README
+    ladder-tuning recipe): given the REAL per-row axis sizes a workload
+    dispatched (e.g. read off the ``pad_waste_<axis>`` histogram /
+    ``axis_counts`` rows of a recorded run), return the minimal
+    ascending rung list under which every observed size pads with at
+    most ``max_waste`` — walk the sizes descending and keep a rung
+    whenever the next-larger kept rung would waste more than the
+    budget.  Feed the result into ``RAFT_TPU_BUCKET_STEPS``."""
+    sizes = sorted({int(s) for s in observed_sizes if int(s) > 0})
+    if not sizes:
+        return ()
+    floor = int(floor if floor is not None else 1)
+    rungs = []
+    last = None
+    for s in reversed(sizes):
+        s = max(s, floor)
+        if last is None or 1.0 - s / last > max_waste:
+            rungs.append(s)
+            last = s
+    return tuple(sorted(set(rungs)))
 
 
 # ------------------------------------------------------------- signature
@@ -113,11 +223,17 @@ def bucket_signature(model):
     if ms is not None and int(getattr(ms, "moorMod", 0) or 0) != 0:
         raise UnbucketableDesignError("moorMod 1/2 line dynamics not bucketed")
     ss = model.hydro[0].strips
-    L = 0 if ms is None else _ceil_pow2(ms.n_lines, LINE_FLOOR)
+    # padded sizes come from the ACTIVE pad ladder (RAFT_TPU_BUCKET_STEPS,
+    # default measured-waste-tuned — see pad_ladder): the signature IS
+    # the padded shape, so every downstream consumer (pack_design,
+    # axis_counts/waste_by_axis, the bank key, warmup) sees the tuned
+    # sizes, never an assumed pow2
+    ladders = pad_ladder()
+    L = 0 if ms is None else _axis_pad(ms.n_lines, "lines", ladders)
     return (
         "rigid6", BUCKET_VERSION,
-        _ceil_pow2(ss.S, STRIP_FLOOR),
-        _ceil_pow2(fs.n_nodes, NODE_FLOOR),
+        _axis_pad(ss.S, "strips", ladders),
+        _axis_pad(fs.n_nodes, "nodes", ladders),
         L,
         tuple(float(x) for x in np.asarray(model.w)),
         int(model.nIter), float(model.XiStart), int(model.nIterExtra),
@@ -400,7 +516,9 @@ def make_bucket_evaluator(sig):
     (X0, Xi, RAO, PSD, S, drag diagnostics, ``status``).
     """
     from raft_tpu.api import _case_status, _policy_cdt
-    from raft_tpu.models.dynamics import solve_dynamics_fowt, system_response
+    from raft_tpu.models.dynamics import (fused_response_enabled,
+                                          solve_dynamics_fowt,
+                                          system_response)
     from raft_tpu.models.statics_solve import solve_equilibrium_general
     from raft_tpu.physics.statics import node_T
 
@@ -492,13 +610,21 @@ def make_bucket_evaluator(sig):
         B_lin = np.zeros((6, 6, nw))
         C_lin = K_h + C_moor
         F_lin = exc["F_hydro_iner"][0]
-        Z, _, Bmat, dyn_diag = solve_dynamics_fowt(
+        Z, Xi_fused, Bmat, dyn_diag = solve_dynamics_fowt(
             fsb, ss, hc, exc["u"][0], M_lin, B_lin, C_lin, F_lin,
             w, Tn, r_nodes, n_iter=n_iter, Xi_start=Xi_start,
             n_iter_extra=n_iter_extra)
-        F_wave = exc["F_hydro_iner"][0] + morison.drag_excitation(
-            fsb, ss, hc, Bmat, exc["u"][0], Tn, r_nodes)
-        Xi = system_response(Z, F_wave[None])[0]
+        if fused_response_enabled():
+            # fused hot path (ROADMAP item 5c): the solve's own final
+            # response already IS F_lin + the separable drag-excitation
+            # fold — re-staging drag_excitation + a second system solve
+            # recomputes the identical quantity (parity gated <=1e-10,
+            # tests/test_fused.py)
+            Xi = Xi_fused
+        else:
+            F_wave = exc["F_hydro_iner"][0] + morison.drag_excitation(
+                fsb, ss, hc, Bmat, exc["u"][0], Tn, r_nodes)
+            Xi = system_response(Z, F_wave[None])[0]
 
         return dict(
             X0=X0, Xi=Xi, RAO=wv.get_rao(Xi, zeta),

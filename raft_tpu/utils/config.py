@@ -140,9 +140,19 @@ _F64_ALIASES = {a: "float64" for a in DTYPE_F64_NAMES}
 
 _register(
     # -- hot-path compute policy
-    Flag("SOLVER", "choice", "native", choices=("native", "lapack"),
+    Flag("SOLVER", "choice", "native",
+         choices=("native", "lapack", "pallas"),
          help="impedance-solve kernel: batched pivot-free native "
-              "elimination or jnp.linalg.solve (golden-parity fallback)"),
+              "elimination, jnp.linalg.solve (golden-parity fallback), "
+              "or the Pallas block-GE kernel prototype (interpret-mode "
+              "on CPU hosts; see README 'Performance')"),
+    Flag("FUSED", "choice", "on", choices=("on", "off"),
+         help="fused case hot path: the rigid single-heading evaluators "
+              "take the response straight from the drag fixed point's "
+              "final solve (the separable drag-excitation fold) instead "
+              "of re-staging drag_excitation + a second system solve; "
+              "'off' restores the staged tail (the parity oracle). "
+              "Trace-time; part of the sweep memo/bank key"),
     Flag("FIXED_POINT", "choice", "auto", choices=("auto", "scan", "while"),
          help="drag-linearisation loop driver ('auto': while on CPU, "
               "masked fixed-trip scan on accelerators)"),
@@ -220,6 +230,19 @@ _register(
               "repeat rows), capping host/device memory for the packed "
               "design batch at chunk x design instead of rows x design "
               "while every chunk reuses ONE compiled program"),
+    Flag("BUCKET_STEPS", "str",
+         "strips=16,24,32,48,64,96,128;nodes=pow2;lines=pow2",
+         help="per-axis shape-bucket pad ladders for the heterogeneous "
+              "design buckets: ';'-separated axis=rungs entries where "
+              "rungs is an ascending comma list (doubling continues "
+              "past the last rung) or 'pow2' (classic power-of-two "
+              "ceiling at the axis floor).  The default strips ladder "
+              "adds midpoint rungs between the pow2 sizes — tuned from "
+              "the PR-11 row-weighted waste_by_axis histograms, it cuts "
+              "the bundled-trio row-weighted strip padding waste from "
+              "0.35 to 0.15 (see README 'Performance').  Changing the "
+              "ladder changes bucket signatures: re-run `python -m "
+              "raft_tpu.aot warmup` so steady-state recompiles stay 0"),
     Flag("BEM_DIR", "str",
          default_factory=lambda: os.path.join(os.getcwd(), "_bem_cache"),
          help="panel-method BEM coefficient cache directory"),
@@ -313,10 +336,35 @@ _register(
     # -- evaluation service (see raft_tpu.serve and README "Evaluation
     #    service")
     Flag("SERVE_TICK_MS", "float", 20.0,
-         help="continuous-batching tick period: pending requests "
+         help="continuous-batching tick CEILING: pending requests "
               "coalesce into one bucketed dispatch per (signature, "
-              "tick) — lower = lower queueing latency, higher = bigger "
-              "batches"),
+              "tick).  Under RAFT_TPU_SERVE_TICK_MODE=adaptive this is "
+              "the window under sustained load; light load shrinks the "
+              "window toward RAFT_TPU_SERVE_TICK_MIN_MS"),
+    Flag("SERVE_TICK_MIN_MS", "float", 1.0,
+         help="adaptive-tick floor: with a near-empty queue the "
+              "coalescing window shrinks to this, so a lone light-load "
+              "request waits ~this long instead of the full tick "
+              "(adaptive mode only)"),
+    Flag("SERVE_TICK_MODE", "choice", "adaptive",
+         choices=("adaptive", "fixed"),
+         help="serve tick policy: 'adaptive' scales the coalescing "
+              "window between SERVE_TICK_MIN_MS and SERVE_TICK_MS with "
+              "the recent per-tick row load and dispatches speculatively "
+              "early when a bucket group fills a top ladder rung; "
+              "'fixed' restores the constant SERVE_TICK_MS window"),
+    Flag("SERVE_LADDER", "str", "cost",
+         help="serve batch-ladder policy: 'pow2' (dp,2dp,... up to "
+              "SERVE_MAX_BATCH), 'cost' (pow2 candidates warmed, then "
+              "rungs whose measured dispatch wall is flat vs the next "
+              "rung are pruned after warmup — fewer programs where "
+              "padding is free, finer rungs where it costs), or an "
+              "explicit ascending comma list of rung sizes"),
+    Flag("SERVE_LADDER_TOL", "float", 1.15,
+         help="cost-ladder flatness tolerance: rung r is pruned when "
+              "the next rung's measured per-dispatch wall is within "
+              "this factor of r's (dispatching padded to the bigger "
+              "rung costs ~nothing, so the extra program buys nothing)"),
     Flag("SERVE_MAX_BATCH", "int", 64,
          help="largest padded batch one serving dispatch holds; the "
               "batch ladder is dp,2*dp,... up to this (programs are "

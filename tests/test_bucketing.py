@@ -36,6 +36,24 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 DESIGNS = os.path.join(HERE, "..", "raft_tpu", "designs")
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _pow2_ladders():
+    """Pin the LEGACY pow2 pad policy for this module: the sharing /
+    parity / chunking contracts here predate the tuned default ladder
+    (RAFT_TPU_BUCKET_STEPS) and deliberately exercise the pow2 path —
+    the spar VARIANT (53 strips) only shares the spar's bucket under a
+    64-strip pow2 ceiling.  The tuned-ladder signatures get their own
+    tests below (test_tuned_ladder_*), which drop the pin."""
+    env = "RAFT_TPU_BUCKET_STEPS"
+    old = os.environ.get(env)
+    os.environ[env] = "pow2"
+    yield
+    if old is None:
+        os.environ.pop(env, None)
+    else:
+        os.environ[env] = old
+
+
 def _spar_variant_design():
     """A spar with a DIFFERENT member layout (extra station, different
     diameter schedule) that still packs into the spar's bucket."""
@@ -283,6 +301,134 @@ def test_bucket_rows_chunked_dispatch(trio, tmp_path, monkeypatch):
     # 14 spar-family rows -> chunks of [8, 6->8]; 6 MHK rows -> one
     # dispatch under the cap
     assert len(disp) == 3
+
+
+# ------------------------------------------- cost-driven pad ladders
+
+def test_pad_ladder_parse_and_validation(monkeypatch):
+    # default: tuned strips rungs, pow2 nodes/lines
+    monkeypatch.delenv("RAFT_TPU_BUCKET_STEPS", raising=False)
+    lad = bucketing.pad_ladder()
+    assert lad["strips"] == (16, 24, 32, 48, 64, 96, 128)
+    assert lad["nodes"] is None and lad["lines"] is None
+    # explicit spec + pow2 literal
+    assert bucketing.pad_ladder("pow2") == dict.fromkeys(
+        ("strips", "nodes", "lines"))
+    lad = bucketing.pad_ladder("strips=10,30;nodes=pow2")
+    assert lad["strips"] == (10, 30) and lad["nodes"] is None
+    for bad in ("strips", "bogus=1,2", "strips=3,2", "strips=0",
+                "strips=a,b"):
+        with pytest.raises(ValueError):
+            bucketing.pad_ladder(bad)
+
+
+def test_axis_pad_floor_and_continuation(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_BUCKET_STEPS", raising=False)
+    # single-design bucket at the floor: anything under the first rung
+    # pads to the floor rung, never below it
+    assert bucketing._axis_pad(1, "strips") == 16
+    assert bucketing._axis_pad(16, "strips") == 16
+    # midpoint rungs between the pow2 sizes
+    assert bucketing._axis_pad(47, "strips") == 48
+    assert bucketing._axis_pad(49, "strips") == 64
+    assert bucketing._axis_pad(73, "strips") == 96
+    # doubling continuation past the last explicit rung
+    assert bucketing._axis_pad(130, "strips") == 256
+    # pow2 axes keep the classic ceiling-at-floor
+    assert bucketing._axis_pad(5, "nodes") == 8
+    assert bucketing._axis_pad(1, "nodes") == 2
+    assert bucketing._axis_pad(0, "lines") == 0  # moorings-free design
+    # custom rungs drive both the pad and the continuation
+    monkeypatch.setenv("RAFT_TPU_BUCKET_STEPS", "strips=10,30")
+    assert bucketing._axis_pad(25, "strips") == 30
+    assert bucketing._axis_pad(31, "strips") == 60
+
+
+def test_tuned_rungs_recipe():
+    """The ladder-seeding recipe: minimal rung set under which every
+    observed axis size pads within the waste budget."""
+    rungs = bucketing.tuned_rungs([14, 47, 53, 73], max_waste=0.2,
+                                  floor=16)
+    assert rungs == (16, 53, 73)
+    for s in (14, 47, 53, 73):
+        pad = min(r for r in rungs if r >= max(s, 0))
+        assert 1.0 - max(s, 16) / pad <= 0.2 + 1e-12
+    assert bucketing.tuned_rungs([]) == ()
+    # a tight budget keeps every distinct size as its own rung
+    assert bucketing.tuned_rungs([20, 40], max_waste=0.0) == (20, 40)
+
+
+def test_tuned_ladder_signatures(trio, monkeypatch):
+    """Under the DEFAULT tuned ladder the padded shapes shrink (spar
+    47->48 instead of 64) and every waste-attribution consumer reports
+    the ACTUAL padded sizes, not an assumed pow2."""
+    monkeypatch.delenv("RAFT_TPU_BUCKET_STEPS", raising=False)
+    models, pow2_sigs = trio
+    spar, spar2, mhk = models
+    sigs = [bucketing.bucket_signature(m) for m in models]
+    assert bucketing.signature_meta(sigs[0])["S"] == 48   # 47 strips
+    assert bucketing.signature_meta(sigs[1])["S"] == 64   # 53 strips
+    # the small MHK sits at the ladder floor: its own micro-bucket
+    # never shrinks below the floor rung
+    assert bucketing.signature_meta(sigs[2])["S"] == 16   # 14 strips
+    # the spar variant no longer shares the spar's bucket (48 vs 64) —
+    # the tuned ladder trades that sharing for 25% less strip padding
+    assert sigs[0] != sigs[1]
+    # row-weighted strips waste strictly improves vs the pow2 policy
+    def strip_waste(sig_list):
+        real = sum(m.hydro[0].strips.S for m in models)
+        padded = sum(bucketing.signature_meta(s)["S"] for s in sig_list)
+        return 1.0 - real / padded
+    assert strip_waste(sigs) < strip_waste(pow2_sigs)
+    # axis_counts / waste_by_axis reflect the tuned padded shapes
+    axes = [bucketing.axis_counts(m, s) for m, s in zip(models, sigs)]
+    assert axes[0]["strips"] == (47, 48)
+    by_axis = bucketing.waste_by_axis(axes)
+    assert by_axis["strips"]["padded"] == 48 + 64 + 16
+    # and pack_design pads to the tuned (non-pow2) size
+    packed = bucketing.pack_design(spar, sigs[0])
+    assert packed["ds"].shape[0] == 48
+    assert packed["strip_mask"].sum() == 47
+
+
+@pytest.mark.slow
+def test_chunked_dispatch_under_non_pow2_steps(trio, tmp_path,
+                                               monkeypatch):
+    """RAFT_TPU_BUCKET_ROWS chunking under a NON-pow2 strip ladder:
+    chunks share one (48-strip) program, results match the solo
+    evaluations, and the bucket_sweep event reports the tuned padded
+    shapes (the waste table fix — actual sizes, never assumed pow2)."""
+    models, _ = trio
+    spar = models[0]
+    monkeypatch.delenv("RAFT_TPU_BUCKET_STEPS", raising=False)
+    rows = [spar] * 10
+    rng = np.random.default_rng(3)
+    Hs = 3.0 + 4.0 * rng.random(10)
+    Tp = 8.0 + 6.0 * rng.random(10)
+    beta = 0.5 * rng.random(10)
+    mesh = make_mesh(1)
+    log = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", log)
+    monkeypatch.setenv("RAFT_TPU_BUCKET_ROWS", "4")
+    with count_compilations() as clog:
+        out = sweep_heterogeneous(rows, Hs, Tp, beta, mesh=mesh,
+                                  out_keys=("X0", "PSD", "status"))
+    # 10 rows -> chunks of 4/4/2->4, ONE compiled 48-strip program
+    assert clog.real_count <= 1
+    with open(log) as f:
+        evs = [json.loads(x) for x in f if x.strip()]
+    disp = [e for e in evs if e["event"] == "span_begin"
+            and e.get("name") == "sweep_dispatch"]
+    assert len(disp) == 3
+    sweep_ev = [e for e in evs if e["event"] == "bucket_sweep"][-1]
+    assert sweep_ev["waste_by_axis"]["strips"]["padded"] == 10 * 48
+    assert sweep_ev["waste_by_axis"]["strips"]["valid"] == 10 * 47
+    solo = jax.jit(make_case_evaluator(spar))
+    for i in range(10):
+        ref = solo(Hs[i], Tp[i], beta[i])
+        np.testing.assert_allclose(out["PSD"][i], np.asarray(ref["PSD"]),
+                                   rtol=1e-10, atol=1e-12)
+        assert int(out["status"][i]) == int(np.asarray(ref["status"]))
 
 
 # --------------------------------------------------- dp auto-pad (toys)

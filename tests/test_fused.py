@@ -1,0 +1,95 @@
+"""Fused case hot path: parity harness + dispatch-count contract.
+
+ROADMAP item 5c: under ``RAFT_TPU_FUSED=on`` (the default) the rigid
+single-heading evaluators take their wave response straight from the
+drag fixed point's final solve — the per-ω excitation assembly (the
+separable drag-excitation fold of ``drag_lin_precompute``) is fused
+into the drag-linearised solve program instead of re-staged as a
+separate ``drag_excitation`` chain + second batched solve.
+
+Contract (tests here, budgets in analysis/jaxpr_contracts.py entry
+``fused_case``):
+
+* fused vs staged (``RAFT_TPU_FUSED=off``) parity <= 1e-10 on every
+  float output, bit-equal int32 status, on ALL THREE bundled designs
+  (spar + semi + MHK) — fold-vs-chain summation order is the only
+  difference, measured at ~1e-15;
+* a case evaluation through the sweep funnel is ONE banked program
+  dispatch (one ``sweep_dispatch`` span), and a repeat dispatch
+  compiles NOTHING.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import raft_tpu
+from raft_tpu.analysis.recompile import count_compilations
+from raft_tpu.api import make_case_evaluator
+from raft_tpu.parallel.sweep import make_mesh, sweep_heterogeneous
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DESIGNS = os.path.join(HERE, "..", "raft_tpu", "designs")
+
+CASES = [(6.0, 12.0, 0.0), (2.5, 7.5, 0.35)]
+
+
+@pytest.fixture(scope="module")
+def bundled_trio():
+    return [raft_tpu.Model(os.path.join(DESIGNS, f)) for f in
+            ("spar_demo.yaml", "semi_demo.yaml", "mhk_demo.yaml")]
+
+
+@pytest.mark.slow
+def test_fused_vs_staged_parity_bundled_trio(bundled_trio, monkeypatch):
+    """Fused path <= 1e-10 vs the staged tail on spar + semi + MHK,
+    int32 status bit-equal."""
+    for model in bundled_trio:
+        res = {}
+        for mode in ("on", "off"):
+            monkeypatch.setenv("RAFT_TPU_FUSED", mode)
+            ev = jax.jit(make_case_evaluator(model))
+            res[mode] = [{k: np.asarray(v) for k, v in ev(*c).items()}
+                         for c in CASES]
+        for i in range(len(CASES)):
+            fused, staged = res["on"][i], res["off"][i]
+            assert int(fused["status"]) == int(staged["status"])
+            assert fused["status"].dtype == np.int32
+            for k in ("X0", "Xi", "RAO", "PSD", "S"):
+                np.testing.assert_allclose(
+                    fused[k], staged[k], rtol=1e-10, atol=1e-12,
+                    err_msg=f"{model.design.get('name')} case {i} {k}")
+
+
+@pytest.mark.slow
+def test_one_banked_program_per_case_dispatch(bundled_trio, tmp_path,
+                                              monkeypatch):
+    """A fused case eval through the sweep funnel is ONE program
+    dispatch, and the steady state recompiles nothing."""
+    monkeypatch.delenv("RAFT_TPU_FUSED", raising=False)
+    spar = bundled_trio[0]
+    mesh = make_mesh(1)
+    log = str(tmp_path / "ev.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", log)
+    out = sweep_heterogeneous([spar], [5.0], [11.0], [0.1], mesh=mesh,
+                              out_keys=("PSD", "X0", "status"))
+    with open(log) as f:
+        evs = [json.loads(x) for x in f if x.strip()]
+    disp = [e for e in evs if e["event"] == "span_begin"
+            and e.get("name") == "sweep_dispatch"]
+    assert len(disp) == 1  # ONE banked program ran the whole case
+    with count_compilations() as clog:
+        out2 = sweep_heterogeneous([spar], [5.0], [11.0], [0.1],
+                                   mesh=mesh,
+                                   out_keys=("PSD", "X0", "status"))
+    assert clog.count == 0  # steady state: zero backend events
+    for k in ("PSD", "X0", "status"):
+        np.testing.assert_array_equal(out[k], out2[k])
+    # and the fused dispatch matches the solo fused evaluator
+    ref = jax.jit(make_case_evaluator(spar))(5.0, 11.0, 0.1)
+    np.testing.assert_allclose(out["PSD"][0], np.asarray(ref["PSD"]),
+                               rtol=1e-10, atol=1e-12)
+    assert int(out["status"][0]) == int(np.asarray(ref["status"]))
