@@ -64,6 +64,22 @@ python -m raft_tpu.analysis contracts
 # raft_tpu.aot gc` reclaims them.  Trivially clean on an empty bank.
 python -m raft_tpu.aot verify
 
+# release-manifest integrity: the checked-in good manifest fixture
+# must verify clean (exit 0) and the tampered twin (one entry sha
+# edited after the cut — signature + content address both break) must
+# be caught with EXACTLY exit 1; pure file check, no bank and no jax
+python -m raft_tpu.aot release verify \
+    --manifest tests/fixtures/releases/good.json > /dev/null
+release_rc=0
+python -m raft_tpu.aot release verify \
+    --manifest tests/fixtures/releases/tampered.json > /dev/null 2>&1 \
+    || release_rc=$?
+if [ "$release_rc" -ne 1 ]; then
+    echo "lint.sh: aot release verify exited $release_rc on the tampered" \
+         "manifest fixture (want 1: tamper caught)" >&2
+    exit 1
+fi
+
 # cross-process trace assembly: the checked-in two-process capture
 # (coordinator + fabric worker, per-process clock anchors) must merge
 # onto one timeline with every span balanced and every parent id

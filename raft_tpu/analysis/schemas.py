@@ -100,6 +100,8 @@ _FLEET = "raft_tpu/serve/fleet.py"
 _ROUTER = "raft_tpu/serve/router.py"
 _ALERTS = "raft_tpu/obs/alerts.py"
 _CANARY = "raft_tpu/serve/canary.py"
+_RELEASE = "raft_tpu/aot/release.py"
+_ROLLOUT = "raft_tpu/serve/rollout.py"
 
 FAMILIES: tuple[Family, ...] = (
     Family(
@@ -207,6 +209,25 @@ FAMILIES: tuple[Family, ...] = (
                  Site(_BANK, "is_stale", "meta"),
                  Site(_BANK, "verify_bank", "meta"),
                  Site(_BANK, "gc_bank", "meta"))),
+    Family(
+        "release-manifest",
+        "signed, content-addressed release manifest (releases/<id>."
+        "json: bank entry shas + code/flags/ladder identity + parent "
+        "chain + captured env — raft_tpu.aot.release)",
+        writers=(Site(_RELEASE, "build_manifest", "man"),
+                 Site(_RELEASE, "sign_manifest", "man", kind="update")),
+        readers=(Site(_RELEASE, "verify_manifest", "man"),
+                 Site(_RELEASE, "verify_against_bank", "man"),
+                 Site(_RELEASE, "classify_mismatch", "man"),
+                 Site(_RELEASE, "walk_parents", "man"),
+                 Site(_RELEASE, "list_releases", "man"),
+                 Site(_RELEASE, "parity_context", "man"))),
+    Family(
+        "rollout-record",
+        "rolling-upgrade outcome record (the run record's extra block "
+        "+ the rollout CLI/drill summary — raft_tpu.serve.rollout)",
+        writers=(Site(_ROLLOUT, "build_record", "record"),),
+        readers=(Site(_ROLLOUT, "summarize_record", "record"),)),
 )
 
 

@@ -6,6 +6,7 @@
     python -m raft_tpu.aot list
     python -m raft_tpu.aot verify
     python -m raft_tpu.aot gc [--max-age-days D] [--all] [--dry-run]
+    python -m raft_tpu.aot release {cut,list,verify,promote,rollback}
 
 Exit codes: 0 clean, 1 problems (verify) / failed warmup, 2 usage.
 
@@ -131,6 +132,125 @@ def _cmd_gc(args):
     return 0
 
 
+def _pin_backend(platform, x64):
+    """The warmup-style jax pins for commands that compute live
+    program identities (cut / verify --against-designs)."""
+    from raft_tpu.utils import config
+
+    platform = platform if platform is not None \
+        else config.get("CLI_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    if x64:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+
+
+def _cmd_release(args):
+    from raft_tpu.aot import release
+
+    if args.release_cmd == "cut":
+        _pin_backend(args.platform, args.x64)
+        man = release.cut(label=args.label, promote_after=args.promote)
+        state = "promoted" if args.promote else "cut"
+        print(f"release {man['release']} {state}: {man['n_entries']} "
+              f"entr{'y' if man['n_entries'] == 1 else 'ies'}, parent "
+              f"{man['parent'] or 'none'} ({release.releases_dir()})")
+        return 0
+
+    if args.release_cmd == "list":
+        cur = release.current_release()
+        mans = release.list_releases()
+        if not mans:
+            print(f"no releases under {release.releases_dir()}")
+            return 0
+        for man in mans:
+            mark = "*" if man["release"] == cur else " "
+            print(f"{mark} {man['release']}  entries={man['n_entries']:<4}"
+                  f" parent={man.get('parent') or '-':<12}"
+                  f" age={_fmt_age(man.get('created'))}"
+                  f"  {man.get('label') or ''}")
+        print(f"{len(mans)} release(s); * = current")
+        return 0
+
+    if args.release_cmd == "verify":
+        return _cmd_release_verify(args)
+
+    if args.release_cmd == "promote":
+        previous = release.promote(args.release)
+        print(f"current -> {args.release} (was {previous or 'unset'})")
+        return 0
+
+    if args.release_cmd == "rollback":
+        rid, parent = release.rollback()
+        print(f"rolled back: current {rid} -> parent {parent}")
+        return 0
+    return 2
+
+
+def _cmd_release_verify(args):
+    """Integrity (+ optionally bank / live-design) check of one
+    release.  ``--manifest PATH`` is a pure file check — no bank, no
+    jax (the lint.sh fixture gate); the default target is the
+    ``current`` release."""
+    from raft_tpu.aot import release
+
+    if args.manifest:
+        man = release.load_manifest(args.manifest)
+        if man is None:
+            print(f"PROBLEM: unreadable manifest {args.manifest}",
+                  file=sys.stderr)
+            return 1
+        problems = release.verify_manifest(man)
+    else:
+        rid = args.release or release.current_release()
+        if rid is None:
+            print("no --release/--manifest given and no current "
+                  "release pointer", file=sys.stderr)
+            return 2
+        man = release.load_release(rid)
+        if man is None:
+            print(f"PROBLEM: no release {rid} under "
+                  f"{release.releases_dir()}", file=sys.stderr)
+            return 1
+        problems = release.verify_manifest(man)
+        if not problems:
+            problems = release.verify_against_bank(man)
+    for p in problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    if problems:
+        print(f"release verify: {len(problems)} problem(s).",
+              file=sys.stderr)
+        return 1
+    if args.against_designs:
+        _pin_backend(args.platform, args.x64)
+        from raft_tpu.serve import engine
+
+        reg = engine.Registry()
+        paths = []
+        for spec in args.against_designs:
+            name, _, path = spec.rpartition("=")
+            path = path or spec
+            reg.register(name or f"design-{len(paths)}", path)
+            paths.append(path)
+        entries = [reg.get(n) for n in reg.names()]
+        report = release.diagnose(entries, manifest=man)
+        if report["unwarmed"]:
+            for line in release.format_diagnosis(report, paths,
+                                                 x64=args.x64):
+                print(line, file=sys.stderr)
+            return 1
+        print(f"release {man['release']}: all {report['total']} serve "
+              "program(s) warmed for the given designs.")
+        return 0
+    print(f"release {man['release']} verified: {man['n_entries']} "
+          f"entr{'y' if man['n_entries'] == 1 else 'ies'}, 0 problems.")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="python -m raft_tpu.aot")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -172,9 +292,42 @@ def main(argv=None):
                    help="empty the bank entirely")
     p.add_argument("--dry-run", action="store_true")
 
+    p = sub.add_parser("release", help="immutable, content-addressed "
+                                       "releases of the bank")
+    rsub = p.add_subparsers(dest="release_cmd", required=True)
+    rp = rsub.add_parser("cut", help="snapshot the warmed bank into a "
+                                     "signed release manifest")
+    rp.add_argument("--label", default=None,
+                    help="free-form annotation (not part of the id)")
+    rp.add_argument("--promote", action="store_true",
+                    help="flip the current pointer to the new release")
+    rp.add_argument("--platform", default=None)
+    rp.add_argument("--x64", action="store_true")
+    rsub.add_parser("list", help="table of releases (* = current)")
+    rp = rsub.add_parser("verify", help="integrity-check a release "
+                                        "(CI gate)")
+    rp.add_argument("--release", default=None,
+                    help="release id (default: the current pointer)")
+    rp.add_argument("--manifest", default=None,
+                    help="verify ONE manifest file in isolation "
+                         "(pure integrity; no bank, no jax)")
+    rp.add_argument("--against-designs", action="append", default=None,
+                    metavar="[NAME=]YAML",
+                    help="also preflight the live designs' program "
+                         "identities against the manifest and name the "
+                         "mismatch class (code/flags/ladder/avals); "
+                         "repeatable")
+    rp.add_argument("--platform", default=None)
+    rp.add_argument("--x64", action="store_true")
+    rp = rsub.add_parser("promote", help="point current at a release "
+                                         "(atomic rename)")
+    rp.add_argument("release")
+    rsub.add_parser("rollback", help="re-point current at its parent")
+
     args = ap.parse_args(argv)
     cmd = {"warmup": _cmd_warmup, "list": _cmd_list,
-           "verify": _cmd_verify, "gc": _cmd_gc}[args.cmd]
+           "verify": _cmd_verify, "gc": _cmd_gc,
+           "release": _cmd_release}[args.cmd]
     return cmd(args)
 
 

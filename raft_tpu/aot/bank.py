@@ -744,6 +744,22 @@ def scan():
         yield key, meta, meta_path, bin_path
 
 
+def read_meta(key):
+    """One entry's sidecar metadata by bank key — or None when the
+    entry (or its payload) is missing/unparseable.  Jax-free: the
+    release machinery resolves manifest entries through this without
+    initializing a backend."""
+    meta_path, bin_path = _paths(key)
+    try:
+        with open(meta_path, encoding="utf-8") as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(meta, dict) or not os.path.exists(bin_path):
+        return None
+    return meta
+
+
 def is_stale(meta):
     """True when an entry's version fingerprint no longer matches the
     running toolchain/sources (it can never be loaded again)."""

@@ -1,0 +1,534 @@
+"""Immutable, content-addressed releases of the AOT program bank.
+
+A warmed bank directory is the deploy artifact — but a directory is
+not a *version*: nothing names the exact entry set a fleet was warmed
+from, so "roll back to yesterday's programs" and "are these two
+replicas serving the same release?" have no answer.  A **release**
+fixes that: a signed manifest snapshotting the bank — every entry key
+with its payload sha, the code fingerprint, the trace-flags
+fingerprint and the batch-ladder state that shaped the keys, plus the
+parent release — written under ``RAFT_TPU_AOT_DIR/releases/`` and
+addressed by a hash of its own content:
+
+* the release id is ``sha256(format, parent, code, flags, ladder,
+  entries)[:12]`` — two cuts of the same bank state under the same
+  flags are the SAME release;
+* the manifest is signed by ``manifest_sha256`` over its canonical
+  JSON body, so any post-cut tamper (edited entry sha, swapped
+  parent) is detected by ``release verify``;
+* the bank directory stays the single content-addressed object store
+  (entries are immutable and shared across releases, like git objects
+  behind refs) — a release is a *view*, so cutting one is a metadata
+  write, never a copy;
+* ``releases/current.json`` is the pointer replicas resolve at warmup
+  (flipped by atomic rename: ``promote``/``rollback``), and the
+  resolved id is stamped into every ``x-raft-provenance`` header —
+  the rolling-upgrade canary distinguishes "mixed-version fleet
+  mid-rollout" from "genuinely skewed replica" by exactly this stamp
+  (``releases/rollout.json`` marks the in-progress window).
+
+CLI: ``python -m raft_tpu.aot release {cut,list,verify,promote,
+rollback}``.  ``verify --manifest`` is a pure integrity check (no
+bank, no jax — the lint.sh fixture gate); ``verify
+--against-designs`` additionally diffs the live designs' program
+identities against the manifest and names the mismatch class (code |
+flags | ladder | avals) — the diagnosis a require-mode replica
+prints before dying on a cold bank.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+from raft_tpu.aot import bank
+from raft_tpu.utils import config
+from raft_tpu.utils.structlog import log_event
+
+RELEASES_DIRNAME = "releases"
+MANIFEST_SCHEMA = "release-manifest-v1"
+
+#: the flags whose values shape bank keys (trace-time program flags +
+#: the batch-ladder geometry): captured into the manifest's ``env``
+#: block so a rollout can spawn candidate replicas under EXACTLY the
+#: environment the release was warmed with
+TRACE_FLAG_NAMES = ("SOLVER", "FIXED_POINT", "SCAN_CHUNK", "DTYPE",
+                    "COND_CHECK", "COND_THRESHOLD", "ITER_SCALE", "FUSED")
+LADDER_FLAG_NAMES = ("SERVE_LADDER", "SERVE_MAX_BATCH",
+                     "BUCKET_STEPS", "BUCKET_ROWS")
+
+
+def releases_dir(aot_dir=None):
+    return os.path.join(aot_dir or config.get("AOT_DIR"), RELEASES_DIRNAME)
+
+
+def manifest_path(release_id, aot_dir=None):
+    return os.path.join(releases_dir(aot_dir), f"{release_id}.json")
+
+
+def current_path(aot_dir=None):
+    return os.path.join(releases_dir(aot_dir), "current.json")
+
+
+def rollout_marker_path(aot_dir=None):
+    return os.path.join(releases_dir(aot_dir), "rollout.json")
+
+
+def _canonical(obj):
+    """Canonical JSON bytes — the signing/addressing domain."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                      default=str).encode()
+
+
+# ----------------------------------------------------------------- build
+
+
+def ladder_state():
+    """The batch-ladder flag values that shape the serve bank keys —
+    part of the release identity: PR-15's gotcha was exactly a ladder
+    retune silently re-keying the bank under a warmed fleet."""
+    return {k: config.get(k) for k in LADDER_FLAG_NAMES}
+
+
+def capture_env():
+    """The explicitly-SET ``RAFT_TPU_*`` environment of the key-shaping
+    flags (unset flags stay unset — the candidate replica then sees
+    the same defaults).  The rollout driver applies this verbatim when
+    spawning replicas of the release."""
+    env = {}
+    for k in TRACE_FLAG_NAMES + LADDER_FLAG_NAMES:
+        name = config.env_name(k)
+        if name in os.environ:
+            env[name] = os.environ[name]
+    return env
+
+
+def snapshot_entries():
+    """``{entry_key: {payload_sha256, kind}}`` of every bank entry the
+    CURRENT code would load (other source states are dead weight, not
+    release content; foreign environments — other platform/topology —
+    are legitimate coexisting variants and stay in)."""
+    code = bank.code_fingerprint()
+    out = {}
+    for key, meta, _mp, bin_path in bank.scan():
+        if meta is None or not os.path.exists(bin_path):
+            continue
+        if meta.get("format") != bank.BANK_FORMAT:
+            continue
+        if (meta.get("version") or {}).get("code") != code:
+            continue
+        out[key] = {"payload_sha256": meta.get("payload_sha256") or "",
+                    "kind": meta.get("kind") or "?"}
+    return out
+
+
+def compute_release_id(parent, code, flags, ladder, entries):
+    """Content address over everything that makes the release what it
+    is (created/label/env are provenance, not identity)."""
+    ident = {"format": bank.BANK_FORMAT, "parent": parent, "code": code,
+             "flags": flags, "ladder": ladder, "entries": entries}
+    return hashlib.sha256(_canonical(ident)).hexdigest()[:12]
+
+
+def sign_manifest(man):
+    """``manifest_sha256`` over the canonical body minus the signature
+    itself; returns the signed manifest."""
+    body = {k: v for k, v in man.items() if k != "manifest_sha256"}
+    man["manifest_sha256"] = hashlib.sha256(_canonical(body)).hexdigest()
+    return man
+
+
+def build_manifest(entries, code, flags, parent=None, label=None):
+    """The release-manifest record (schema family
+    ``release-manifest``)."""
+    ladder = ladder_state()
+    man = {
+        "schema": MANIFEST_SCHEMA,
+        "release": compute_release_id(parent, code, flags, ladder,
+                                      entries),
+        "created": time.time(),
+        "label": str(label or ""),
+        "parent": parent,
+        "bank_format": bank.BANK_FORMAT,
+        "code": code,
+        "flags": flags,
+        "ladder": ladder,
+        "env": capture_env(),
+        "entries": dict(entries),
+        "n_entries": len(entries),
+        "manifest_sha256": "",  # filled by sign_manifest below
+    }
+    return sign_manifest(man)
+
+
+def cut(label=None, flags_fp=None, promote_after=False):
+    """Cut a release from the current bank snapshot; returns the
+    written manifest.  ``flags_fp`` defaults to the live serving
+    flags fingerprint (:func:`raft_tpu.serve.engine.
+    flags_fingerprint` — imports jax; pass one explicitly to stay
+    jax-free).  Cutting an identical state twice is idempotent: same
+    id, same file."""
+    if flags_fp is None:
+        from raft_tpu.serve import engine
+
+        flags_fp = engine.flags_fingerprint()
+    entries = snapshot_entries()
+    man = build_manifest(entries, bank.code_fingerprint(), str(flags_fp),
+                         parent=current_release(), label=label)
+    os.makedirs(releases_dir(), exist_ok=True)
+    bank._atomic_write(
+        manifest_path(man["release"]),
+        (json.dumps(man, indent=1, sort_keys=True) + "\n").encode())
+    log_event("release_cut", release=man["release"], parent=man["parent"],
+              entries=man["n_entries"], label=man["label"] or None)
+    if promote_after:
+        promote(man["release"])
+    return man
+
+
+# ------------------------------------------------------------------ load
+
+
+def load_manifest(path):
+    """Parse one manifest file; None when missing/garbled (a reader
+    must never crash on a foreign file)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return man if isinstance(man, dict) else None
+
+
+def load_release(release_id, aot_dir=None):
+    return load_manifest(manifest_path(release_id, aot_dir))
+
+
+def list_releases(aot_dir=None):
+    """Every readable manifest under releases/, newest first."""
+    d = releases_dir(aot_dir)
+    out = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json") or name in ("current.json",
+                                                  "rollout.json"):
+            continue
+        man = load_manifest(os.path.join(d, name))
+        if man is not None and man.get("release") == name[:-5]:
+            out.append(man)
+    out.sort(key=lambda m: m.get("created") or 0, reverse=True)
+    return out
+
+
+def current_release(aot_dir=None):
+    """The id the ``current`` pointer names, or None."""
+    try:
+        with open(current_path(aot_dir), encoding="utf-8") as f:
+            rec = json.load(f)
+        return str(rec["release"]) if isinstance(rec, dict) else None
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def resolve(aot_dir=None):
+    """``(release_id, manifest)`` through the current pointer —
+    what a replica resolves at warmup — or ``(None, None)`` when no
+    release infrastructure is in use (pointer-less banks keep
+    working: releases are opt-in)."""
+    rid = current_release(aot_dir)
+    if rid is None:
+        return None, None
+    return rid, load_release(rid, aot_dir)
+
+
+# ---------------------------------------------------------------- verify
+
+
+def verify_manifest(man):
+    """Pure integrity problems of one manifest (no bank access): the
+    schema, the self-signature, and the content address must all
+    hold.  The lint.sh fixture gate runs exactly this."""
+    problems = []
+    if not isinstance(man, dict) or man.get("schema") != MANIFEST_SCHEMA:
+        return [f"not a {MANIFEST_SCHEMA} manifest"]
+    for k in ("release", "code", "flags", "ladder", "entries",
+              "manifest_sha256", "bank_format"):
+        if k not in man:
+            problems.append(f"missing required key {k!r}")
+    if problems:
+        return problems
+    body = {k: v for k, v in man.items() if k != "manifest_sha256"}
+    want = hashlib.sha256(_canonical(body)).hexdigest()
+    if man["manifest_sha256"] != want:
+        problems.append("manifest_sha256 mismatch (tampered or "
+                        "hand-edited manifest)")
+    rid = compute_release_id(man.get("parent"), man["code"], man["flags"],
+                             man["ladder"], man["entries"])
+    if man["release"] != rid:
+        problems.append(f"release id {man['release']} does not match "
+                        f"its content (expect {rid})")
+    if man["bank_format"] != bank.BANK_FORMAT:
+        problems.append(f"bank format {man['bank_format']} != "
+                        f"{bank.BANK_FORMAT} (foreign toolchain)")
+    return problems
+
+
+def verify_against_bank(man):
+    """Problems of a release vs the live bank directory: every
+    manifest entry must exist with its exact payload sha (a release
+    whose objects were gc'd or rewritten cannot be served)."""
+    problems = []
+    for key, ent in sorted((man.get("entries") or {}).items()):
+        meta = bank.read_meta(key)
+        if meta is None:
+            problems.append(f"{key}: bank entry missing/unreadable "
+                            "(gc'd from under the release?)")
+            continue
+        if meta.get("payload_sha256") != ent.get("payload_sha256"):
+            problems.append(f"{key}: bank payload sha differs from the "
+                            "manifest (entry rewritten after the cut)")
+    return problems
+
+
+def walk_parents(release_id, aot_dir=None, max_depth=64):
+    """The parent chain starting at ``release_id`` (inclusive), oldest
+    last; cycles/missing parents just end the walk."""
+    chain, seen = [], set()
+    rid = release_id
+    while rid and rid not in seen and len(chain) < max_depth:
+        seen.add(rid)
+        man = load_release(rid, aot_dir)
+        if man is None:
+            break
+        chain.append(man)
+        rid = man.get("parent")
+    return chain
+
+
+# --------------------------------------------------------------- pointer
+
+
+def promote(release_id, aot_dir=None):
+    """Flip ``current`` to ``release_id`` (atomic rename — a reader
+    sees the old pointer or the new one, never a torn write).
+    Returns the previous id.  The manifest must exist and verify."""
+    man = load_release(release_id, aot_dir)
+    if man is None:
+        raise FileNotFoundError(
+            f"no release {release_id!r} under {releases_dir(aot_dir)} "
+            "(cut it first: python -m raft_tpu.aot release cut)")
+    problems = verify_manifest(man)
+    if problems:
+        raise ValueError(f"refusing to promote {release_id}: "
+                         + "; ".join(problems))
+    previous = current_release(aot_dir)
+    os.makedirs(releases_dir(aot_dir), exist_ok=True)
+    bank._atomic_write(
+        current_path(aot_dir),
+        (json.dumps({"release": str(release_id), "t": time.time()})
+         + "\n").encode())
+    log_event("release_promote", release=str(release_id),
+              previous=previous)
+    return previous
+
+
+def rollback(aot_dir=None):
+    """Re-point ``current`` at the current release's parent.  Returns
+    ``(from_id, to_id)``."""
+    rid = current_release(aot_dir)
+    if rid is None:
+        raise FileNotFoundError("no current release to roll back from")
+    man = load_release(rid, aot_dir)
+    parent = (man or {}).get("parent")
+    if not parent:
+        raise ValueError(f"release {rid} has no parent to roll back to")
+    promote(parent, aot_dir)
+    log_event("release_rollback", release=rid, to=parent)
+    return rid, parent
+
+
+# --------------------------------------------------------- rollout marker
+
+
+def write_rollout_marker(from_id, to_id, aot_dir=None):
+    """Mark a rolling upgrade in progress: BOTH releases are
+    legitimate fleet members until the marker clears — the canary's
+    provenance-consistency check reads this window."""
+    os.makedirs(releases_dir(aot_dir), exist_ok=True)
+    bank._atomic_write(
+        rollout_marker_path(aot_dir),
+        (json.dumps({"from": from_id, "to": to_id, "t": time.time()})
+         + "\n").encode())
+
+
+def read_rollout_marker(aot_dir=None):
+    try:
+        with open(rollout_marker_path(aot_dir), encoding="utf-8") as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def clear_rollout_marker(aot_dir=None):
+    try:
+        os.remove(rollout_marker_path(aot_dir))
+        return True
+    except OSError:
+        return False
+
+
+_PARITY_LOCK = threading.Lock()
+#: (aot_dir, computed_t, value) — the canary calls parity_context per
+#: probe observation; 1s of staleness is fine, per-probe file IO is not
+_PARITY_CACHE: list = []  # raft-lint: guarded-by=_PARITY_LOCK
+
+
+def parity_context(aot_dir=None, ttl_s=1.0, now=None):
+    """The release view the provenance-consistency check needs:
+    ``{"allowed": [release ids legitimately in the fleet], "entries":
+    {release_id: [16-char payload sha prefixes]}}`` — or None when no
+    release infrastructure is present (the pre-release behavior).
+    Mid-rollout the marker's from/to are BOTH allowed; otherwise only
+    ``current`` is.  Cached ~1s: called per canary observation."""
+    aot_dir = aot_dir or config.get("AOT_DIR")
+    now = time.monotonic() if now is None else now
+    with _PARITY_LOCK:
+        if _PARITY_CACHE and _PARITY_CACHE[0] == aot_dir \
+                and now - _PARITY_CACHE[1] < ttl_s:
+            return _PARITY_CACHE[2]
+    rid = current_release(aot_dir)
+    value = None
+    if rid is not None:
+        allowed = {rid}
+        marker = read_rollout_marker(aot_dir)
+        if marker:
+            allowed |= {str(v) for v in (marker.get("from"),
+                                         marker.get("to")) if v}
+        entries = {}
+        for r in sorted(allowed):
+            man = load_release(r, aot_dir)
+            if man is not None:
+                entries[r] = sorted(
+                    {str(e.get("payload_sha256") or "")[:16]
+                     for e in (man.get("entries") or {}).values()})
+        value = {"allowed": sorted(allowed), "entries": entries}
+    with _PARITY_LOCK:
+        _PARITY_CACHE[:] = [aot_dir, now, value]
+    return value
+
+
+# ------------------------------------------------------------- diagnosis
+
+
+def classify_mismatch(man, code, flags, ladder):
+    """WHY a live process misses a release's bank entries, in key-
+    component precedence order: a code edit re-keys everything (check
+    first), then a trace-flag flip, then a ladder retune; ``avals``
+    is the remainder (design set / out_keys / batch-shape drift)."""
+    if man.get("code") != code:
+        return "code"
+    if man.get("flags") != flags:
+        return "flags"
+    if {k: man.get("ladder", {}).get(k) for k in LADDER_FLAG_NAMES} \
+            != {k: ladder.get(k) for k in LADDER_FLAG_NAMES}:
+        return "ladder"
+    return "avals"
+
+
+def diagnose(entries, mesh=None, out_keys=None, sizes=None,
+             manifest=None):
+    """Bank-warmth report of the live design set vs a release: for
+    every (design x ladder rung) program, is it banked — and when not,
+    WHICH key component drifted from the manifest.  Imports jax (the
+    program identities are real bank keys).  Returns ``{"release",
+    "total", "warmed", "unwarmed": [{design, rows, key, reason}],
+    "reason"}``."""
+    from raft_tpu.parallel.sweep import make_mesh
+    from raft_tpu.serve import engine
+
+    if mesh is None:
+        mesh = make_mesh()
+    out_keys = engine.normalize_out_keys(out_keys)
+    sizes = tuple(sizes) if sizes else engine.batch_ladder(mesh)
+    man = manifest or {}
+    reason = classify_mismatch(man, bank.code_fingerprint(),
+                               engine.flags_fingerprint(),
+                               ladder_state()) if man else None
+    unwarmed, total = [], 0
+    for entry in entries:
+        for rows in sizes:
+            total += 1
+            try:
+                key, side = engine.program_identity(
+                    entry, mesh=mesh, out_keys=out_keys, rows=rows)
+            except Exception:  # noqa: BLE001 — diagnosis is telemetry
+                key, side = None, None
+            if side is not None:
+                continue
+            why = reason or "avals"
+            if man and key and key in (man.get("entries") or {}):
+                why = "bank-missing"  # manifest promises it; bank lost it
+            unwarmed.append({"design": entry.name, "rows": int(rows),
+                             "key": key, "reason": why})
+    report = {"release": man.get("release"), "total": total,
+              "warmed": total - len(unwarmed), "unwarmed": unwarmed,
+              "reason": (reason if unwarmed else None)}
+    log_event("release_preflight", release=report["release"],
+              unwarmed=len(unwarmed), total=total,
+              reason=report["reason"])
+    return report
+
+
+def warmup_command(design_paths, x64=False):
+    """The exact re-warm command a failed preflight prints."""
+    cmd = "python -m raft_tpu.aot warmup --kinds serve"
+    for p in design_paths:
+        cmd += f" --design {p}"
+    if x64:
+        cmd += " --x64"
+    return cmd
+
+
+_REASON_HELP = {
+    "code": "the raft_tpu source changed since the release was cut "
+            "(every bank key embeds the code fingerprint)",
+    "flags": "trace-time RAFT_TPU_* flags differ from the release "
+             "(SOLVER/DTYPE/ITER_SCALE/... are part of every key)",
+    "ladder": "the batch ladder changed (SERVE_LADDER/SERVE_MAX_BATCH/"
+              "BUCKET_* retune re-keys the serve programs — cut a new "
+              "release and roll it out instead of re-warming by hand)",
+    "avals": "the design set / out_keys / batch shapes differ from "
+             "what was warmed",
+    "bank-missing": "the manifest promises this entry but the bank "
+                    "directory lost it (gc'd or deleted?)",
+}
+
+
+def format_diagnosis(report, design_paths=(), x64=False):
+    """Human lines for a failed preflight: which programs are cold,
+    why, and the exact command that fixes it."""
+    lines = []
+    rel = report.get("release")
+    head = (f"release {rel}" if rel else "bank (no release manifest)")
+    lines.append(f"bank preflight vs {head}: "
+                 f"{len(report['unwarmed'])}/{report['total']} serve "
+                 "program(s) UNWARMED")
+    for row in report["unwarmed"]:
+        lines.append(f"  {row['design']} x rows={row['rows']}: "
+                     f"{row['reason']} (key {row['key']})")
+    reasons = {row["reason"] for row in report["unwarmed"]}
+    for r in sorted(reasons):
+        if r in _REASON_HELP:
+            lines.append(f"  why [{r}]: {_REASON_HELP[r]}")
+    if design_paths:
+        lines.append("warm the bank, then cut + promote a release:")
+        lines.append(f"  {warmup_command(design_paths, x64=x64)}")
+        lines.append("  python -m raft_tpu.aot release cut --promote")
+    return lines

@@ -635,8 +635,10 @@ def render_sink_summary(records):
 # ----------------------------------------------------- provenance codec
 
 #: field order of the ``x-raft-provenance`` header (fixed, so the
-#: header is byte-stable for a given provenance dict)
-PROVENANCE_FIELDS = ("bank_key", "bank_sha", "code", "flags", "replica")
+#: header is byte-stable for a given provenance dict); ``release`` is
+#: last — pre-release replicas simply omit it and old parsers ignore it
+PROVENANCE_FIELDS = ("bank_key", "bank_sha", "code", "flags", "replica",
+                     "release")
 
 
 def format_provenance(prov):
@@ -667,26 +669,60 @@ def parse_provenance(value):
     return out or None
 
 
-def provenance_consistency(by_design):
+def provenance_consistency(by_design, releases=None):
     """Cross-replica provenance verdict over ``{design: {replica:
     prov_dict}}``: two replicas serving the SAME design must agree on
     the bank payload sha, bank key, code hash and flags key (replica
     id legitimately differs).  Returns ``{"consistent": bool,
     "splits": [{design, field, values: {replica: value}}]}`` — the
     canary feeds this into the ``canary_parity`` rule context so the
-    alert payload names the offending provenance."""
+    alert payload names the offending provenance.
+
+    ``releases`` makes the verdict VERSION-AWARE (:func:`raft_tpu.
+    aot.release.parity_context`): ``{"allowed": [release ids
+    legitimately in the fleet], "entries": {release_id: [16-char
+    payload sha prefixes]}}``.  A mid-rollout fleet spans two release
+    ids, so cross-replica comparison happens *within* a release group
+    (mixed-version skew is expected, not an alarm), while a replica
+    stamping an id outside ``allowed`` — or a bank sha its own
+    release's manifest never shipped — is a genuine skew even when it
+    is the lone replica on that release (the seeded
+    ``provenance_skew`` drill).  ``releases=None`` is exactly the
+    pre-release behavior."""
+    allowed = set((releases or {}).get("allowed") or ())
+    manifest_shas = {rid: set(shas) for rid, shas in
+                     ((releases or {}).get("entries") or {}).items()}
     splits = []
     for design in sorted(by_design or {}):
         provs = {rid: p for rid, p in (by_design[design] or {}).items()
                  if p}
-        if len(provs) < 2:
-            continue
-        for field in ("bank_sha", "bank_key", "code", "flags"):
-            values = {rid: (p.get(field) or "none")
-                      for rid, p in provs.items()}
-            if len(set(values.values())) > 1:
-                splits.append({"design": design, "field": field,
-                               "values": dict(sorted(values.items()))})
+        if releases:
+            groups = {}
+            for rid, p in provs.items():
+                rel = p.get("release") or "none"
+                if allowed and rel not in allowed:
+                    splits.append({"design": design, "field": "release",
+                                   "values": {rid: rel}})
+                    continue
+                sha = p.get("bank_sha") or "none"
+                shipped = manifest_shas.get(rel)
+                if shipped is not None and sha not in shipped \
+                        and sha != "none":
+                    splits.append({"design": design, "field": "bank_sha",
+                                   "values": {rid: sha}})
+                    continue
+                groups.setdefault(rel, {})[rid] = p
+        else:
+            groups = {None: provs}
+        for group in groups.values():
+            if len(group) < 2:
+                continue
+            for field in ("bank_sha", "bank_key", "code", "flags"):
+                values = {rid: (p.get(field) or "none")
+                          for rid, p in group.items()}
+                if len(set(values.values())) > 1:
+                    splits.append({"design": design, "field": field,
+                                   "values": dict(sorted(values.items()))})
     return {"consistent": not splits, "splits": splits}
 
 

@@ -255,9 +255,12 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         "the router exited after letting in-flight proxied requests "
         "finish"),
     "router_ring_update": (
-        ("added", "removed", "n_replicas"),
+        ("added", "removed", "n_replicas", "replaced?"),
         "the membership prober reconciled the hash ring against the "
-        "lease ledger (join/drain/evict — zero router restarts)"),
+        "lease ledger (join/drain/evict — zero router restarts); "
+        "replaced names replicas whose lease moved to a new endpoint "
+        "under the SAME id (rolling-upgrade takeover): their vnodes "
+        "stay put, only the endpoint + breaker reset"),
     "router_request": (
         ("replica", "code", "attempts", "hedged", "design", "wall_s",
          "provenance?"),
@@ -317,6 +320,76 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         "provenance split (stale bank, env skew, flag divergence) — "
         "feeds canary_pass/canary_fail and the canary-parity alert "
         "rule"),
+    "replica_takeover": (
+        ("replica", "port", "prev_port", "root"),
+        "a rolling-upgrade replacement SEIZED an existing live lease "
+        "under the same replica id (atomic rewrite, then /drain to "
+        "the predecessor): the router sees one endpoint replacement, "
+        "never a remove+add ring churn pair"),
+    # ---------------------------------- releases & rolling upgrades
+    "release_cut": (
+        ("release", "parent", "entries", "label?"),
+        "an immutable content-addressed release manifest was cut from "
+        "the current bank snapshot (python -m raft_tpu.aot release "
+        "cut): bank entry keys + payload shas + code hash + flags "
+        "fingerprint + parent release, signed by its own sha"),
+    "release_promote": (
+        ("release", "previous"),
+        "the releases/current pointer was flipped (atomic rename) to "
+        "a new release — replicas resolve their bank through this "
+        "pointer at warmup"),
+    "release_rollback": (
+        ("release", "to"),
+        "the current pointer was re-pointed at the release's parent "
+        "(operator rollback, or the rollout driver's automatic "
+        "rollback on a canary failure)"),
+    "release_resolve": (
+        ("release", "root"),
+        "a serve replica resolved its bank through the current "
+        "release pointer at warmup; the release id is stamped into "
+        "every x-raft-provenance response header"),
+    "release_preflight": (
+        ("release", "unwarmed", "total", "reason?"),
+        "the release-vs-designs bank preflight ran (aot release "
+        "verify --against-designs, or a require-mode replica dying "
+        "on a BankMissError): how many design/rung programs are "
+        "unwarmed and the mismatch class (code | flags | ladder | "
+        "avals)"),
+    "rollout_start": (
+        ("to", "from", "replicas", "root"),
+        "a canary-gated rolling upgrade began: current flipped to the "
+        "candidate release, the rollout marker written, replicas to "
+        "be surf-replaced one at a time (raft_tpu.serve.rollout)"),
+    "rollout_step": (
+        ("replica", "phase", "ok", "wall_s?"),
+        "one rollout step finished (phase: spawn | join | canary): "
+        "the named replica was replaced in place and the mixed-"
+        "version fleet's canary verdict gated promotion to the next"),
+    "rollout_rollback": (
+        ("to", "reason", "aborted"),
+        "the rollout aborted (canary failure, alert fire, or a step "
+        "timeout) and automatically rolled back: current re-pointed "
+        "at the parent release, upgraded replicas rolled back the "
+        "same surf-replace way; aborted names the abandoned release"),
+    "rollout_done": (
+        ("to", "ok", "replaced", "rolled_back", "wall_s"),
+        "the rolling upgrade finished: every replica replaced and "
+        "canary-green (ok=true), or rolled back to the parent "
+        "release (ok=false) — one run record + one merged trace per "
+        "rollout either way"),
+    # --------------------------------------------- SLO autoscaler
+    "autoscale_out": (
+        ("replicas", "reason", "pressure"),
+        "the autoscaler added a replica on sustained hot alert state "
+        "(slo-breach / breaker-storm firing past "
+        "RAFT_TPU_AUTOSCALE_OUT_FOR_S): warm-bank spawn, zero real "
+        "XLA compiles (raft_tpu.serve.autoscale)"),
+    "autoscale_in": (
+        ("replica", "replicas", "reason", "occupancy"),
+        "the autoscaler drained one replica after sustained low "
+        "cost-ledger occupancy (under RAFT_TPU_AUTOSCALE_LOW_OCC for "
+        "RAFT_TPU_AUTOSCALE_IN_FOR_S, past the cooldown, never below "
+        "RAFT_TPU_AUTOSCALE_MIN)"),
     # --------------------------------------------- run-record store
     "run_record": (
         ("kind", "path", "label?"),
@@ -405,6 +478,12 @@ SPANS: dict[str, str] = {
     "router_upstream": "one upstream attempt of the failover ladder "
                        "(child of router_request; retries and hedges "
                        "each get their own)",
+    "rollout": "one canary-gated rolling upgrade, pointer flip through "
+               "the last replica replacement (or the automatic "
+               "rollback) — root of the rollout_step tree",
+    "rollout_step": "one replica's surf-replacement inside a rollout "
+                    "(spawn + ledger join + canary gate), child of "
+                    "the rollout span",
 }
 
 

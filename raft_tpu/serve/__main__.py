@@ -31,6 +31,13 @@ Failover router (the one endpoint clients talk to)::
 
     python -m raft_tpu.serve router --fleet-dir DEPLOY_DIR --port 8788
 
+Canary-gated rolling upgrade to a cut release (automatic rollback on
+a red canary or firing alert — see :mod:`raft_tpu.serve.rollout`)::
+
+    python -m raft_tpu.serve rollout --fleet-dir DEPLOY_DIR \
+        --to RELEASE_ID --designs spar=... \
+        [--router-url http://127.0.0.1:8788]
+
 ``--port 0`` binds an ephemeral port; the ready line on stdout
 (``serving N design(s) on http://host:port ...`` / ``routing N
 replica(s) ...``) reports the actual one (load harnesses parse it).
@@ -101,6 +108,12 @@ def _serve_main(argv):
                          "RAFT_TPU_FLEET_DIR when set)")
     ap.add_argument("--replica-id", default=None,
                     help="fleet replica id (default: a fresh unique id)")
+    ap.add_argument("--takeover", action="store_true",
+                    help="SEIZE the replica id's existing fleet lease "
+                         "after warmup+bind instead of claiming fresh "
+                         "(the rolling-upgrade replacement path: same "
+                         "rid keeps the same ring vnodes; the previous "
+                         "owner is then drained by the rollout driver)")
     args = ap.parse_args(argv)
 
     from raft_tpu.utils import config
@@ -114,12 +127,15 @@ def _serve_main(argv):
     if args.x64:
         jax.config.update("jax_enable_x64", True)
 
+    from raft_tpu.aot import bank as bank_mod
+    from raft_tpu.aot import release as release_mod
     from raft_tpu.serve import engine
     from raft_tpu.serve import fleet as fleet_mod
     from raft_tpu.serve.batcher import Batcher
     from raft_tpu.serve.http import run_server
     from raft_tpu.structure.bucketing import signature_fingerprint
     from raft_tpu.utils.devices import enable_compile_cache
+    from raft_tpu.utils.structlog import log_event
 
     enable_compile_cache()
     registry = engine.Registry()
@@ -132,13 +148,37 @@ def _serve_main(argv):
         print(f"registered {name}: bucket "
               f"{signature_fingerprint(entry.sig)}", flush=True)
 
+    # resolve the bank through the release pointer FIRST: the resolved
+    # id is stamped into every provenance header, and a warmup miss is
+    # diagnosed against this release's manifest (releases are opt-in —
+    # a pointer-less bank serves exactly as before)
+    cur_release, cur_manifest = release_mod.resolve()
+    if cur_release:
+        log_event("release_resolve", release=cur_release,
+                  root=release_mod.releases_dir())
+        print(f"release: {cur_release}", flush=True)
+
     out_keys = tuple(k.strip() for k in args.out_keys.split(",") if k.strip())
     batcher = Batcher(registry, out_keys=out_keys)
     if not args.no_warm:
-        reports = engine.warm(
-            [registry.get(n) for n in registry.names()],
-            mesh=batcher.mesh, out_keys=batcher.out_keys,
-            sizes=batcher.sizes)
+        try:
+            reports = engine.warm(
+                [registry.get(n) for n in registry.names()],
+                mesh=batcher.mesh, out_keys=batcher.out_keys,
+                sizes=batcher.sizes)
+        except bank_mod.BankMissError:
+            # RAFT_TPU_AOT=require on a cold/stale bank: die with the
+            # full preflight diagnosis (which programs, which key
+            # component drifted, the exact re-warm command) instead of
+            # one opaque bank key
+            report = release_mod.diagnose(
+                [registry.get(n) for n in registry.names()],
+                mesh=batcher.mesh, out_keys=batcher.out_keys,
+                sizes=batcher.sizes, manifest=cur_manifest)
+            for line in release_mod.format_diagnosis(
+                    report, sorted(designs.values()), x64=args.x64):
+                print(line, file=sys.stderr)
+            return 3
         loaded = sum(r["loaded"] for r in reports)
         compiled = sum(r["compiled"] for r in reports)
         wall = sum(r["wall_s"] for r in reports)
@@ -206,15 +246,29 @@ def _serve_main(argv):
 
         def healthz():
             s = batcher.stats()
+            # busy_s: cumulative on-device wall across every banked
+            # program — the autoscaler derives fleet occupancy from
+            # lease-to-lease deltas of this
+            busy = sum(float(r.get("wall_s") or 0)
+                       for r in bank_mod.ledger_summary())
             return {"draining": bool(s["draining"]),
                     "pending": int(s["pending"]),
-                    "cache": s["cache"]}
+                    "cache": s["cache"],
+                    "busy_s": round(busy, 4)}
 
         buckets = sorted({m["sig"] for m in meta.values()})
         served_keys = list(batcher.out_keys)
-        if not ledger.claim(server.port, host=server.host, designs=meta,
-                            buckets=buckets, healthz=healthz(),
-                            out_keys=served_keys):
+        if args.takeover:
+            # rolling-upgrade replacement: unconditionally take the
+            # lease over (same rid = same ring vnodes — zero key
+            # movement); the rollout driver drains the previous owner
+            # only after this succeeds, so membership never gaps
+            ledger.seize(server.port, host=server.host, designs=meta,
+                         buckets=buckets, healthz=healthz(),
+                         out_keys=served_keys)
+        elif not ledger.claim(server.port, host=server.host, designs=meta,
+                              buckets=buckets, healthz=healthz(),
+                              out_keys=served_keys):
             # a lease already exists under this forced id.  Only a
             # crashed predecessor's EXPIRED lease may be evicted — a
             # live one means another replica is serving under this id
@@ -329,10 +383,16 @@ def _router_main(argv):
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8788,
                     help="0 binds an ephemeral port (see the ready line)")
+    ap.add_argument("--designs", action="append", default=[],
+                    help="name=design.yaml forwarded to replicas the "
+                         "AUTOSCALER spawns (RAFT_TPU_AUTOSCALE_EVAL_S "
+                         "> 0 enables the scaling daemon; without "
+                         "designs it can only scale in)")
     args = ap.parse_args(argv)
 
     from raft_tpu.obs import alerts as alerts_mod
     from raft_tpu.serve.router import run_router
+    from raft_tpu.utils import config
 
     root = _default_fleet_dir(args.fleet_dir)
     if not root:
@@ -344,6 +404,14 @@ def _router_main(argv):
     # default rule pack watches (RAFT_TPU_ALERT_EVAL_S > 0; served at
     # GET /alerts)
     alerts_mod.maybe_start()
+    scaler = None
+    if float(config.get("AUTOSCALE_EVAL_S") or 0) > 0:
+        from raft_tpu.serve import autoscale as autoscale_mod
+
+        scaler = autoscale_mod.Autoscaler(root, args.designs)
+        scaler.start()
+        print(f"autoscale: [{scaler.minimum}, {scaler.maximum}] "
+              f"replicas every {scaler.interval_s}s", flush=True)
 
     def ready(router):
         snap = router.state.snapshot()
@@ -353,8 +421,46 @@ def _router_main(argv):
 
     asyncio.run(run_router(root, host=args.host, port=args.port,
                            ready=ready))
+    if scaler is not None:
+        scaler.stop()
     alerts_mod.stop()
     return 0
+
+
+def _rollout_main(argv):
+    ap = argparse.ArgumentParser(prog="python -m raft_tpu.serve rollout")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="fleet deploy directory (default: "
+                         "RAFT_TPU_FLEET_DIR)")
+    ap.add_argument("--to", required=True,
+                    help="candidate release id (cut + verified; the "
+                         "driver promotes it, then surf-replaces the "
+                         "fleet replica by replica)")
+    ap.add_argument("--designs", action="append", default=[],
+                    help="name=design.yaml forwarded to the upgraded "
+                         "replicas")
+    ap.add_argument("--router-url", default=None,
+                    help="router base URL whose GET /alerts gates each "
+                         "step (canary verdicts + active alerts); "
+                         "omitting it skips the canary gate — testing "
+                         "only")
+    args = ap.parse_args(argv)
+
+    from raft_tpu.serve import rollout as rollout_mod
+
+    root = _default_fleet_dir(args.fleet_dir)
+    if not root:
+        print("--fleet-dir (or RAFT_TPU_FLEET_DIR) is required",
+              file=sys.stderr)
+        return 2
+    if not args.designs:
+        print("no designs (--designs name=path)", file=sys.stderr)
+        return 2
+    record = rollout_mod.run_rollout(root, args.to, args.designs,
+                                     router_url=args.router_url)
+    print(json.dumps(record, indent=1, default=str))
+    print(rollout_mod.summarize_record(record), flush=True)
+    return 0 if record.get("ok") else 1
 
 
 def main(argv=None):
@@ -363,6 +469,8 @@ def main(argv=None):
         return _fleet_main(argv[1:])
     if argv and argv[0] == "router":
         return _router_main(argv[1:])
+    if argv and argv[0] == "rollout":
+        return _rollout_main(argv[1:])
     return _serve_main(argv)
 
 

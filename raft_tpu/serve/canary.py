@@ -88,6 +88,21 @@ def golden_key(fingerprint, case, out_keys):
                              "beta": float(beta)}, out_keys)
 
 
+def _release_view():
+    """The release-parity context for :func:`raft_tpu.obs.alerts.
+    provenance_consistency` — which release ids are legitimately in
+    the fleet right now (mid-rollout: two of them) and which bank shas
+    each shipped.  None (pre-release behavior) when no release
+    infrastructure is present or readable; the canary must keep
+    working against pointer-less banks."""
+    try:
+        from raft_tpu.aot import release
+
+        return release.parity_context()
+    except Exception:  # noqa: BLE001 — parity must not die on IO
+        return None
+
+
 def decode_outputs(outputs_json):
     """Host numpy arrays from one ``/evaluate`` response's ``outputs``
     payload (complex values arrive split as ``{"real", "imag"}`` —
@@ -116,6 +131,21 @@ class CanaryState:
         self._goldens: dict = {}  # raft-lint: guarded-by=self._lock
         #: {design: {replica: provenance dict}} — the parity check's view
         self._provenance: dict = {}  # raft-lint: guarded-by=self._lock
+        #: {(design, replica): "addr:port" the stamp was probed from} —
+        #: a same-rid TAKEOVER (rolling upgrade) replaces the process
+        #: behind the endpoint, so a stamp observed from the previous
+        #: endpoint is void, not evidence of skew
+        self._prov_from: dict = {}  # raft-lint: guarded-by=self._lock
+        #: {replica: {"endpoint", "n"}} — observations folded in AT the
+        #: replica's current endpoint; the run restarts when the
+        #: endpoint changes or the replica departs, so the rollout
+        #: gate's fresh-probe count only credits the CURRENT process.
+        #: (A gate counting fleet-wide passes goes green off the
+        #: candidate's healthy neighbors; one counting per-rid probes
+        #: still goes green off the OLD process, which keeps answering
+        #: its drain window while the canary's membership snapshot is
+        #: a beat stale — the endpoint is the process identity.)
+        self._probes: dict = {}  # raft-lint: guarded-by=self._lock
         #: {golden key or "provenance": failure detail} currently failing
         self._failing: dict = {}  # raft-lint: guarded-by=self._lock
 
@@ -178,7 +208,7 @@ class CanaryState:
     # ------------------------------------------------------------ observe
 
     def observe(self, design, replica, fingerprint, case, out_keys,
-                outputs, status, provenance=None):
+                outputs, status, provenance=None, endpoint=None):
         """Fold one probe response in: first response per golden key
         becomes the golden, later ones compare; the provenance joins
         the per-design cross-replica view.  Returns the verdict dict
@@ -194,9 +224,19 @@ class CanaryState:
         else:
             ok, reason = self.compare(golden, outputs, status)
         with self._lock:
+            run = self._probes.get(str(replica))
+            if run is None or (endpoint is not None
+                               and run.get("endpoint") != str(endpoint)):
+                run = {"endpoint": (str(endpoint) if endpoint is not None
+                                    else None), "n": 0}
+                self._probes[str(replica)] = run
+            run["n"] += 1
             if provenance is not None:
                 self._provenance.setdefault(str(design), {})[
                     str(replica)] = dict(provenance)
+                if endpoint is not None:
+                    self._prov_from[(str(design), str(replica))] = \
+                        str(endpoint)
             if ok:
                 self._failing.pop(key, None)
             else:
@@ -224,7 +264,8 @@ class CanaryState:
         ``(failing, provenance_verdict)``."""
         with self._lock:
             prov_view = {d: dict(m) for d, m in self._provenance.items()}
-        prov = alerts.provenance_consistency(prov_view)
+        prov = alerts.provenance_consistency(prov_view,
+                                             releases=_release_view())
         with self._lock:
             if prov["consistent"]:
                 self._failing.pop("provenance", None)
@@ -244,19 +285,48 @@ class CanaryState:
         membership: a drained/evicted/replaced replica's provenance
         stamp must not ghost-split parity forever (a rolling upgrade
         REPLACES stamps, it does not accumulate them).  Goldens stay —
-        they are content-addressed and replica-agnostic.  Returns True
-        when anything was dropped."""
+        they are content-addressed and replica-agnostic.
+
+        ``replicas`` is the membership view: an iterable of replica
+        ids, or ``{rid: {"addr", "port", ...}}`` (the router's live
+        snapshot) — with endpoints, a stamp observed from an endpoint
+        the rid no longer answers at is ALSO dropped (a same-rid
+        takeover mid-rolling-upgrade: the old process's stamp would
+        otherwise red-flag parity for one probe interval right as the
+        expected-skew window closes).  Returns True when anything was
+        dropped."""
         keep = {str(r) for r in replicas}
+        endpoints = {}
+        if isinstance(replicas, dict):
+            for rid, info in replicas.items():
+                if isinstance(info, dict) and "port" in info:
+                    endpoints[str(rid)] = \
+                        f"{info.get('addr')}:{info['port']}"
         changed = False
+        reset_rids = set()
         with self._lock:
             for design in list(self._provenance):
                 members = self._provenance[design]
                 for rid in list(members):
-                    if rid not in keep:
+                    seen_at = self._prov_from.get((design, rid))
+                    stale = (rid not in keep
+                             or (seen_at is not None
+                                 and rid in endpoints
+                                 and seen_at != endpoints[rid]))
+                    if stale:
                         del members[rid]
+                        self._prov_from.pop((design, rid), None)
+                        reset_rids.add(rid)
                         changed = True
                 if not members:
                     del self._provenance[design]
+            for rid in list(self._probes):
+                if rid not in keep or rid in reset_rids:
+                    # departed or replaced: the observation run restarts
+                    # for the new process (observe() also restarts it on
+                    # its own when the probed endpoint changes)
+                    del self._probes[rid]
+                    changed = True
             for key in list(self._failing):
                 if key != "provenance" and \
                         self._failing[key].get("replica") not in keep:
@@ -274,13 +344,16 @@ class CanaryState:
             goldens = len(self._goldens)
             failing = {k: dict(v) for k, v in self._failing.items()}
             prov_view = {d: dict(m) for d, m in self._provenance.items()}
+            probes = {rid: dict(run) for rid, run in self._probes.items()}
         return {
             "goldens": goldens,
             "passes": metrics.counter("canary_pass").value,
             "fails": metrics.counter("canary_fail").value,
+            "probes": probes,
             "parity_ok": not failing,
             "failing": failing,
-            "provenance": alerts.provenance_consistency(prov_view),
+            "provenance": alerts.provenance_consistency(
+                prov_view, releases=_release_view()),
         }
 
 
@@ -384,9 +457,11 @@ class RouterCanary(threading.Thread):
         """One canary pass over the current membership; returns the
         verdict list."""
         snap = self.state.snapshot()
-        # departed replicas (drained/evicted/replaced) must not
-        # ghost-split the provenance parity view forever
-        self.canary.prune(set(snap["replicas"]))
+        # departed replicas (drained/evicted) must not ghost-split the
+        # provenance parity view forever, and a REPLACED replica's
+        # stamp (same rid, new endpoint after a rolling-upgrade
+        # takeover) is the old process's — void it before comparing
+        self.canary.prune(snap["replicas"])
         fingerprints = self.state.design_fingerprints()
         verdicts = []
         for rid, info in sorted(snap["replicas"].items()):
@@ -411,7 +486,8 @@ class RouterCanary(threading.Thread):
                 verdicts.append(self.canary.observe(
                     design, rid, fp, self.case, out_keys,
                     decode_outputs(body.get("outputs")), body["status"],
-                    provenance=prov))
+                    provenance=prov,
+                    endpoint=f"{info['addr']}:{info['port']}"))
         return verdicts
 
     def run(self):

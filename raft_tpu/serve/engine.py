@@ -471,6 +471,16 @@ def build_provenance(registry, mesh=None, out_keys=DEFAULT_OUT_KEYS,
     rid = str(replica_id or f"pid-{os.getpid()}")
     skewed = faults.take("provenance_skew", "serve_provenance")
     base = {"code": code, "flags": flags, "replica": rid}
+    try:
+        from raft_tpu.aot import release as _release
+
+        rel = _release.current_release()
+    except Exception:  # noqa: BLE001 — provenance is telemetry
+        rel = None
+    if rel:
+        # the release id resolved through the current pointer at warmup
+        # — the version-aware canary groups replicas by this stamp
+        base["release"] = rel
     out = {"*": dict(base)}
     for name in registry.names():
         entry = registry.get(name)

@@ -139,6 +139,43 @@ class FleetLedger:
                   designs=sorted(rec["designs"]), root=self.root)
         return True
 
+    def seize(self, port, host="127.0.0.1", designs=None, buckets=None,
+              healthz=None, out_keys=None):
+        """TAKE OVER an existing lease: unconditionally rewrite the
+        replica id's lease with THIS process's record + token (one
+        atomic rename — readers see the old owner or the new one,
+        never a gap in membership).  The rolling-upgrade replacement
+        path: the upgraded process warms + binds first, seizes the
+        SAME rid (same ring vnodes — zero key movement), and only then
+        drains the old process; the old owner's renew/release no-op on
+        the token mismatch.  Outside a rollout, prefer :meth:`claim` —
+        seizing a healthy stranger's lease is an operator error this
+        method will happily commit."""
+        os.makedirs(_replicas_dir(self.root), exist_ok=True)
+        prev, _ = self.read(self.replica_id)
+        now = time.time()
+        rec = {
+            "replica": self.replica_id,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "addr": str(host),
+            "port": int(port),
+            "claimed_t": now,
+            "renewed_t": now,
+            "ttl_s": float(config.get("FLEET_TTL_S")),
+            "designs": dict(designs or {}),
+            "buckets": list(buckets or ()),
+            "out_keys": list(out_keys or ()),
+            "healthz": dict(healthz or {}),
+            "token": self.token,
+        }
+        lease_rewrite(_lease_path(self.root, self.replica_id), rec)
+        metrics.counter("fleet_takeovers").inc()
+        log_event("replica_takeover", replica=self.replica_id,
+                  port=int(port),
+                  prev_port=(prev or {}).get("port"), root=self.root)
+        return True
+
     def renew(self, healthz=None):
         """Refresh ``renewed_t`` (+ the health snapshot); False when
         the lease is no longer this replica's (evicted or released) —

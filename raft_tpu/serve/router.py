@@ -235,22 +235,35 @@ class RouterState:
 
     def apply_membership(self, live):
         """Reconcile the ring against ``{replica_id: lease_record}``
-        (the ledger's live set).  Returns ``(added, removed)``."""
+        (the ledger's live set).  Returns ``(added, removed,
+        replaced)`` — ``replaced`` is the same-rid *endpoint* changes
+        (a rolling-upgrade takeover re-binds the replica id to a new
+        port): the rid keeps its vnodes, so NO key moves and no other
+        replica's assignment is touched; only its breaker resets (the
+        old endpoint's failure history says nothing about the new
+        process)."""
         with self._lock:
             added = sorted(set(live) - set(self._replicas))
             removed = sorted(set(self._replicas) - set(live))
+            replaced = []
             for rid in removed:
                 self._ring.remove(rid)
                 self._replicas.pop(rid, None)
                 self._breakers.pop(rid, None)
             for rid, rec in live.items():
-                self._replicas[rid] = {
+                old = self._replicas.get(rid)
+                info = {
                     "addr": str(rec.get("addr") or "127.0.0.1"),
                     "port": int(rec.get("port") or 0),
                     "designs": dict(rec.get("designs") or {}),
                     "out_keys": list(rec.get("out_keys") or ()),
                     "healthz": dict(rec.get("healthz") or {}),
                 }
+                if old is not None and (old["addr"], old["port"]) != \
+                        (info["addr"], info["port"]):
+                    replaced.append(rid)
+                    self._breakers[rid] = Breaker()
+                self._replicas[rid] = info
                 if rid not in self._ring:
                     self._ring.add(rid)
                 self._breakers.setdefault(rid, Breaker())
@@ -259,7 +272,7 @@ class RouterState:
                 for name, d in info["designs"].items():
                     designs.setdefault(name, dict(d or {}))
             self._designs = designs
-        return added, removed
+        return added, removed, sorted(replaced)
 
     def endpoint(self, rid):
         with self._lock:
@@ -460,10 +473,10 @@ class LedgerProber(threading.Thread):
                     rec.get("port") or 0) is None}
             live = {rid: rec for rid, rec in live.items()
                     if rid not in self._deferred}
-        added, removed = self.state.apply_membership(live)
-        if added or removed:
+        added, removed, replaced = self.state.apply_membership(live)
+        if added or removed or replaced:
             log_event("router_ring_update", added=added, removed=removed,
-                      n_replicas=len(live))
+                      replaced=replaced, n_replicas=len(live))
             metrics.gauge("router_replicas").set(len(live))
         # breaker recovery without client traffic: a HALF-OPEN replica
         # (cooldown served) that answers /healthz closes via the normal

@@ -493,6 +493,50 @@ _register(
               "second copy at the next ring replica and the first "
               "good response wins (0 disables; duplicate dispatch is "
               "benign — content-addressed result caches)"),
+    # -- zero-downtime releases: canary-gated rolling upgrades (see
+    #    raft_tpu.serve.rollout and README "Releases & rollouts")
+    Flag("ROLLOUT_HEALTH_TIMEOUT_S", "float", 180.0,
+         help="per-replica rollout step budget: the upgraded replica "
+              "must bind, join the fleet ledger and clear its canary "
+              "gate within this window, or the rollout aborts and "
+              "rolls back automatically"),
+    Flag("ROLLOUT_CANARY_PROBES", "int", 2,
+         help="green canary passes required after each replica "
+              "replacement before the rollout promotes to the next "
+              "replica (0 skips the canary gate — testing only)"),
+    Flag("ROLLOUT_POLL_S", "float", 0.5,
+         help="rollout driver poll period while waiting on lease "
+              "joins and canary verdicts"),
+    # -- SLO-driven autoscaler (see raft_tpu.serve.autoscale)
+    Flag("AUTOSCALE_EVAL_S", "float", 0.0,
+         help="autoscaler evaluation period in seconds (0 disables — "
+              "no thread, no state): a router-side daemon scales the "
+              "replica fleet out on sustained slo-breach/breaker-"
+              "storm alert state and in on low cost-ledger occupancy"),
+    Flag("AUTOSCALE_MIN", "int", 1,
+         help="autoscaler floor: scale-in never drops the fleet below "
+              "this many live replicas"),
+    Flag("AUTOSCALE_MAX", "int", 4,
+         help="autoscaler ceiling: scale-out never grows the fleet "
+              "past this many live replicas"),
+    Flag("AUTOSCALE_OUT_FOR_S", "float", 3.0,
+         help="sustain window of the scale-out signal: the hot "
+              "condition (slo-breach/breaker-storm firing) must hold "
+              "this long before a replica is added (the alert "
+              "engine's for-duration state machine)"),
+    Flag("AUTOSCALE_IN_FOR_S", "float", 15.0,
+         help="sustain window of the scale-in signal: cost-ledger "
+              "occupancy must stay under AUTOSCALE_LOW_OCC this long "
+              "before a replica is drained (hysteresis against "
+              "flapping — deliberately longer than the out window)"),
+    Flag("AUTOSCALE_COOLDOWN_S", "float", 30.0,
+         help="minimum seconds between ANY two autoscaler actions "
+              "(out or in): a scale-out's warmup/join transient must "
+              "never read as the next scale signal"),
+    Flag("AUTOSCALE_LOW_OCC", "float", 0.1,
+         help="scale-in occupancy threshold: fleet-mean busy fraction "
+              "(cost-ledger busy seconds per wall second per replica) "
+              "under this is a shrink candidate"),
     # -- multi-host distributed runtime (dryrun-tested on CPU; wired
     #    into resilience.resolve_mesh for real pods)
     Flag("DIST", "bool", False,

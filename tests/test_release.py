@@ -1,0 +1,310 @@
+"""Release-manifest tests (fast, jax-free paths): cut / verify /
+promote / rollback over a tmp releases dir — atomic current flips,
+tampered-manifest rejection, parent-chain walks, the rollout-marker
+parity window, and the mismatch classifier that names why a bank went
+cold."""
+
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def rel_env(tmp_path, monkeypatch):
+    """A release module pointed at an empty tmp AOT dir (every path in
+    release.py resolves through RAFT_TPU_AOT_DIR on each call)."""
+    from raft_tpu.aot import release
+
+    monkeypatch.setenv("RAFT_TPU_AOT_DIR", str(tmp_path))
+    # the parity cache is keyed by aot_dir but ~1s fresh — reset it so
+    # parallel tmp dirs never serve each other's view
+    release._PARITY_CACHE[:] = []
+    return release
+
+
+def _entries(n=2, sha_char="a"):
+    return {f"k{i}": {"payload_sha256": sha_char * 64, "kind": "serve"}
+            for i in range(n)}
+
+
+def _cut(release, entries=None, flags="f" * 12, label=None, parent=None,
+         promote_after=False):
+    """A jax-free cut: build + write the manifest exactly like
+    release.cut but with an injected flags fingerprint and entry set
+    (no bank, no jax)."""
+    from raft_tpu.aot import bank
+
+    man = release.build_manifest(entries if entries is not None
+                                 else _entries(),
+                                 bank.code_fingerprint(), flags,
+                                 parent=parent, label=label)
+    os.makedirs(release.releases_dir(), exist_ok=True)
+    bank._atomic_write(
+        release.manifest_path(man["release"]),
+        (json.dumps(man, indent=1, sort_keys=True) + "\n").encode())
+    if promote_after:
+        release.promote(man["release"])
+    return man
+
+
+# ------------------------------------------------------- identity & sign
+
+
+def test_release_id_is_content_addressed(rel_env):
+    release = rel_env
+    a = _cut(release, flags="f1")
+    b = _cut(release, flags="f1")
+    c = _cut(release, flags="f2")
+    # same content = same release (idempotent cut), different flags =
+    # different id
+    assert a["release"] == b["release"]
+    assert a["release"] != c["release"]
+    assert len(a["release"]) == 12
+    # created/label are provenance, not identity
+    d = _cut(release, flags="f1", label="relabeled")
+    assert d["release"] == a["release"]
+
+
+def test_verify_manifest_clean_and_tampered(rel_env):
+    release = rel_env
+    man = _cut(release)
+    assert release.verify_manifest(man) == []
+    # tamper one entry sha after the cut: signature AND content
+    # address both break
+    bad = json.loads(json.dumps(man))
+    next(iter(bad["entries"].values()))["payload_sha256"] = "e" * 64
+    problems = release.verify_manifest(bad)
+    assert any("manifest_sha256" in p for p in problems)
+    assert any("does not match its content" in p for p in problems)
+    # a re-signed tamper still fails the content address
+    resigned = release.sign_manifest(dict(bad))
+    problems = release.verify_manifest(resigned)
+    assert problems and all("manifest_sha256" not in p for p in problems)
+    # swapped parent breaks the id too
+    swapped = dict(man)
+    swapped["parent"] = "fff000fff000"
+    assert release.verify_manifest(release.sign_manifest(dict(swapped)))
+    # not-a-manifest
+    assert release.verify_manifest({"schema": "nope"})
+    assert release.verify_manifest(None)
+
+
+def test_checked_in_lint_fixtures_verify_as_expected(rel_env):
+    """The lint.sh gate's fixture pair must keep meaning what the gate
+    says: good verifies clean, tampered is caught."""
+    release = rel_env
+    fx = os.path.join(ROOT, "tests", "fixtures", "releases")
+    with open(os.path.join(fx, "good.json"), encoding="utf-8") as f:
+        good = json.load(f)
+    with open(os.path.join(fx, "tampered.json"), encoding="utf-8") as f:
+        tampered = json.load(f)
+    assert release.verify_manifest(good) == []
+    assert release.verify_manifest(tampered)
+
+
+# ------------------------------------------------------ pointer lifecycle
+
+
+def test_promote_flips_current_atomically(rel_env, tmp_path):
+    release = rel_env
+    a = _cut(release, flags="fa")
+    b = _cut(release, flags="fb")
+    assert release.current_release() is None
+    assert release.promote(a["release"]) is None
+    assert release.current_release() == a["release"]
+    # promote returns the PREVIOUS id (the rollout driver logs it)
+    assert release.promote(b["release"]) == a["release"]
+    rid, man = release.resolve()
+    assert rid == b["release"] and man["release"] == b["release"]
+    # the pointer is one small json file written via atomic rename —
+    # no tmp litter left beside it
+    names = os.listdir(release.releases_dir())
+    assert "current.json" in names
+    assert not [n for n in names if n.endswith(".tmp")]
+
+
+def test_promote_refuses_missing_or_tampered(rel_env):
+    release = rel_env
+    with pytest.raises(FileNotFoundError):
+        release.promote("000000000000")
+    man = _cut(release)
+    # corrupt the stored manifest in place: promote must refuse
+    path = release.manifest_path(man["release"])
+    bad = json.loads(open(path, encoding="utf-8").read())
+    bad["entries"]["k0"]["payload_sha256"] = "e" * 64
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(bad, f)
+    with pytest.raises(ValueError, match="refusing to promote"):
+        release.promote(man["release"])
+
+
+def test_rollback_walks_to_parent(rel_env):
+    release = rel_env
+    a = _cut(release, flags="fa", promote_after=True)
+    b = _cut(release, flags="fb", parent=a["release"],
+             promote_after=True)
+    assert release.current_release() == b["release"]
+    assert release.rollback() == (b["release"], a["release"])
+    assert release.current_release() == a["release"]
+    # the root release has nothing to roll back to
+    with pytest.raises(ValueError, match="no parent"):
+        release.rollback()
+
+
+def test_walk_parents_chain_and_cycle_guard(rel_env):
+    release = rel_env
+    a = _cut(release, flags="fa")
+    b = _cut(release, flags="fb", parent=a["release"])
+    c = _cut(release, flags="fc", parent=b["release"])
+    chain = release.walk_parents(c["release"])
+    assert [m["release"] for m in chain] == [c["release"], b["release"],
+                                             a["release"]]
+    # a manufactured parent cycle ends the walk instead of spinning
+    a2 = json.loads(open(release.manifest_path(a["release"]),
+                         encoding="utf-8").read())
+    a2["parent"] = c["release"]
+    with open(release.manifest_path(a["release"]), "w",
+              encoding="utf-8") as f:
+        json.dump(a2, f)
+    chain = release.walk_parents(c["release"])
+    assert len(chain) == 3
+
+
+def test_list_releases_newest_first_skips_pointers(rel_env):
+    release = rel_env
+    assert release.list_releases() == []
+    a = _cut(release, flags="fa", promote_after=True)
+    b = _cut(release, flags="fb", parent=a["release"])
+    release.write_rollout_marker(a["release"], b["release"])
+    ids = [m["release"] for m in release.list_releases()]
+    assert set(ids) == {a["release"], b["release"]}
+    assert ids[0] == b["release"]  # newest first
+    # a foreign json in the dir is ignored, never crashed on
+    with open(os.path.join(release.releases_dir(), "junk.json"),
+              "w", encoding="utf-8") as f:
+        f.write("{not json")
+    assert len(release.list_releases()) == 2
+
+
+# ---------------------------------------------------- parity window view
+
+
+def test_parity_context_rollout_window(rel_env):
+    release = rel_env
+    # no release infrastructure: None (legacy canary behavior)
+    assert release.parity_context(now=0.0) is None
+    a = _cut(release, entries=_entries(sha_char="a"), flags="fa",
+             promote_after=True)
+    ctx = release.parity_context(now=10.0)
+    assert ctx["allowed"] == [a["release"]]
+    assert ctx["entries"][a["release"]] == ["a" * 16]
+    # mid-rollout BOTH ids are allowed, each with its own sha set
+    b = _cut(release, entries=_entries(sha_char="b"), flags="fb",
+             parent=a["release"])
+    release.promote(b["release"])
+    release.write_rollout_marker(a["release"], b["release"])
+    ctx = release.parity_context(now=20.0)
+    assert ctx["allowed"] == sorted([a["release"], b["release"]])
+    assert ctx["entries"][b["release"]] == ["b" * 16]
+    # the cache serves the stale view inside ttl, recomputes after
+    release.clear_rollout_marker()
+    assert release.parity_context(now=20.5)["allowed"] == ctx["allowed"]
+    assert release.parity_context(now=30.0)["allowed"] == [b["release"]]
+
+
+def test_version_aware_provenance_consistency(rel_env):
+    """The canary contract: a mixed-version fleet mid-rollout is
+    consistent; a replica on a release outside the window, or a sha
+    outside its release's manifest, still splits."""
+    from raft_tpu.obs.alerts import provenance_consistency
+
+    releases = {"allowed": ["relA", "relB"],
+                "entries": {"relA": ["a" * 16], "relB": ["b" * 16]}}
+    # the stamp's bank_sha is the 16-char payload-sha prefix (see
+    # serve.engine.build_provenance)
+    prov = lambda rel, sha: {"release": rel, "bank_sha": sha,  # noqa: E731
+                             "bank_key": "k", "code": "c", "flags": "f"}
+    # mixed versions, each sha shipped by its release: expected state
+    view = {"d": {"r0": prov("relA", "a" * 16),
+                  "r1": prov("relB", "b" * 16)}}
+    assert provenance_consistency(view, releases=releases)["consistent"]
+    # same view WITHOUT the release context: the legacy check splits
+    legacy = provenance_consistency(view)
+    assert not legacy["consistent"]
+    # a lone replica whose sha its release never shipped: genuine skew
+    view = {"d": {"r0": prov("relA", "a" * 16),
+                  "r1": prov("relB", "skew" + "e" * 12)}}
+    res = provenance_consistency(view, releases=releases)
+    assert not res["consistent"]
+    assert any(s["field"] == "bank_sha" for s in res["splits"])
+    # a release id outside the rollout window: split on "release"
+    view = {"d": {"r0": prov("relZ", "a" * 16)}}
+    res = provenance_consistency(view, releases=releases)
+    assert any(s["field"] == "release" for s in res["splits"])
+
+
+# ------------------------------------------------------------- diagnosis
+
+
+def test_classify_mismatch_precedence(rel_env, monkeypatch):
+    release = rel_env
+    ladder = release.ladder_state()
+    man = {"code": "c1", "flags": "f1", "ladder": dict(ladder)}
+    assert release.classify_mismatch(man, "c2", "f1", ladder) == "code"
+    assert release.classify_mismatch(man, "c1", "f2", ladder) == "flags"
+    retuned = dict(ladder, SERVE_MAX_BATCH=999)
+    assert release.classify_mismatch(man, "c1", "f1", retuned) == "ladder"
+    assert release.classify_mismatch(man, "c1", "f1", ladder) == "avals"
+
+
+def test_format_diagnosis_names_reason_and_fix(rel_env):
+    release = rel_env
+    report = {"release": "abc123abc123", "total": 4, "warmed": 2,
+              "unwarmed": [{"design": "spar", "rows": 8, "key": "k1",
+                            "reason": "ladder"},
+                           {"design": "spar", "rows": 16, "key": "k2",
+                            "reason": "bank-missing"}],
+              "reason": "ladder"}
+    lines = release.format_diagnosis(report,
+                                     design_paths=["designs/spar.yaml"])
+    text = "\n".join(lines)
+    assert "2/4" in text and "UNWARMED" in text
+    assert "why [ladder]" in text and "why [bank-missing]" in text
+    # the printed fix is the exact runbook: warmup then cut --promote
+    assert "python -m raft_tpu.aot warmup --kinds serve" in text
+    assert "--design designs/spar.yaml" in text
+    assert "release cut --promote" in text
+
+
+def test_capture_env_only_set_flags(rel_env, monkeypatch):
+    release = rel_env
+    monkeypatch.delenv("RAFT_TPU_SERVE_MAX_BATCH", raising=False)
+    monkeypatch.setenv("RAFT_TPU_BUCKET_STEPS", "strips=16,32")
+    env = release.capture_env()
+    assert env.get("RAFT_TPU_BUCKET_STEPS") == "strips=16,32"
+    assert "RAFT_TPU_SERVE_MAX_BATCH" not in env
+
+
+def test_release_cli_verify_manifest_paths(rel_env, tmp_path):
+    """The CLI surface lint.sh gates on, exercised in-process."""
+    from raft_tpu.aot.__main__ import main
+
+    man = _cut(rel_env)
+    path = rel_env.manifest_path(man["release"])
+    assert main(["release", "verify", "--manifest", path]) == 0
+    bad = json.loads(open(path, encoding="utf-8").read())
+    bad["flags"] = "tampered"
+    bad_path = str(tmp_path / "bad.json")
+    with open(bad_path, "w", encoding="utf-8") as f:
+        json.dump(bad, f)
+    assert main(["release", "verify", "--manifest", bad_path]) == 1
+    # list + promote + rollback round-trip through the CLI
+    b = _cut(rel_env, flags="fb", parent=man["release"])
+    assert main(["release", "promote", man["release"]]) == 0
+    assert main(["release", "promote", b["release"]]) == 0
+    assert main(["release", "list"]) == 0
+    assert main(["release", "rollback"]) == 0
+    assert rel_env.current_release() == man["release"]
