@@ -52,6 +52,27 @@ if [ "$schema_rc" -ne 1 ]; then
     exit 1
 fi
 
+# protocol model checker: every shared-fs mutation site in the six
+# protocol modules (fabric, fleet, release, rollout, router, canary)
+# must match the checked-in analysis/protocol_baseline.json — no
+# unmodeled raw writes, no unpinned sites — and the exhaustive
+# interleaving + crash-injection explorer must find no invariant
+# violation over the real protocol functions; each seeded historical
+# race (pre-PR-13 claim live-twin, pre-PR-16 fleet-wide gate race,
+# a raw-rename sidecar) must be caught with EXACTLY exit 1
+python -m raft_tpu.analysis protocol check
+for fixture in claim_hijack gate_fleetwide unmodeled_site; do
+    proto_rc=0
+    python -m raft_tpu.analysis protocol check \
+        --fixture "tests/fixtures/protocol/$fixture.py" > /dev/null 2>&1 \
+        || proto_rc=$?
+    if [ "$proto_rc" -ne 1 ]; then
+        echo "lint.sh: analysis protocol exited $proto_rc on the" \
+             "$fixture fixture (want 1: seeded race caught)" >&2
+        exit 1
+    fi
+done
+
 # jaxpr contracts over the health-instrumented entry points
 # (solve_dynamics_fowt, the design evaluator, the status fold): the
 # status word must stay gather-free/callback-free and inside the

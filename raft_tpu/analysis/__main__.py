@@ -1,17 +1,20 @@
 """CLI for the trace-hygiene + concurrency-invariant suite.
 
-    python -m raft_tpu.analysis lint [paths...]
-    python -m raft_tpu.analysis concurrency [paths...]
-    python -m raft_tpu.analysis schemas [--write | --fixture]
+    python -m raft_tpu.analysis lint [--json] [paths...]
+    python -m raft_tpu.analysis concurrency [--json] [paths...]
+    python -m raft_tpu.analysis schemas [--json] [--write | --fixture]
+    python -m raft_tpu.analysis protocol {check,extract,baseline}
+        [--json] [--write] [--fixture PATH] [--static-only]
     python -m raft_tpu.analysis contracts [--design YAML] [--modes ...]
     python -m raft_tpu.analysis baseline --write [--design YAML]
     python -m raft_tpu.analysis flags
 
 Exit codes: 0 clean, 1 findings/violations, 2 usage error.  ``lint``,
-``concurrency``, ``schemas`` and ``flags`` are jax-free;
+``concurrency``, ``schemas``, ``protocol`` and ``flags`` are jax-free;
 ``contracts``/``baseline`` trace the entry points and pin the CPU
 backend first (accelerator plugins in this image can hang backend init
-— the lint gate must never).
+— the lint gate must never).  ``--json`` swaps the human text for one
+machine-readable document (see :mod:`raft_tpu.analysis.report`).
 """
 
 from __future__ import annotations
@@ -21,54 +24,52 @@ import sys
 
 
 def _cmd_lint(args):
-    from raft_tpu.analysis import lint
+    from raft_tpu.analysis import lint, report
 
     findings = lint.lint_paths(args.paths or None)
     if not args.paths:
         # the dead-entry audit only makes sense over the full scan set
         # (a partial path list would flag every registration as dead)
         findings.extend(lint.registered_unused())
-    for f in findings:
-        print(f.format())
-    if findings:
+    rc = report.emit(
+        "lint", findings, args.json,
+        clean_note="lint clean "
+        f"({len(args.paths) or len(lint.default_paths())} files).")
+    if rc and not args.json:
         print(f"{len(findings)} finding(s). Suppress intentional ones with "
               "`# raft-lint: disable=<rule>`.", file=sys.stderr)
-        return 1
-    print("lint clean "
-          f"({len(args.paths) or len(lint.default_paths())} files).")
-    return 0
+    return rc
 
 
 def _cmd_concurrency(args):
-    from raft_tpu.analysis import concurrency
+    from raft_tpu.analysis import concurrency, report
 
     findings = concurrency.analyze_paths(args.paths or None)
-    for f in findings:
-        print(f.format())
-    if findings:
-        print(f"{len(findings)} finding(s). Suppress audited exceptions "
-              "with `# raft-lint: disable=<rule>`.", file=sys.stderr)
-        return 1
     scope = (f"{len(args.paths)} file(s)" if args.paths
              else "shared-state + serve modules")
-    print(f"concurrency invariants clean ({scope}).")
-    return 0
+    rc = report.emit("concurrency", findings, args.json,
+                     clean_note=f"concurrency invariants clean ({scope}).")
+    if rc and not args.json:
+        print(f"{len(findings)} finding(s). Suppress audited exceptions "
+              "with `# raft-lint: disable=<rule>`.", file=sys.stderr)
+    return rc
 
 
 def _cmd_schemas(args):
-    from raft_tpu.analysis import schemas
+    from raft_tpu.analysis import report, schemas
 
     if args.fixture:
         violations, _ = schemas.run_fixture_checks()
-        for v in violations:
-            print(v)
         if not violations:
             print("schema drift fixture produced NO violations — the "
                   "engine is broken", file=sys.stderr)
             return 2
-        print(f"{len(violations)} violation(s) (seeded fixture drill).",
-              file=sys.stderr)
-        return 1
+        rc = report.emit("schemas", violations, args.json,
+                         extra={"fixture": True})
+        if not args.json:
+            print(f"{len(violations)} violation(s) (seeded fixture drill).",
+                  file=sys.stderr)
+        return rc
     if args.write:
         contracts = schemas.extract_all()
         drift = []
@@ -86,18 +87,88 @@ def _cmd_schemas(args):
               f"({len(contracts)} families)")
         return 0
     violations, contracts = schemas.run_checks()
-    for v in violations:
-        print(v)
-    if violations:
+    n_keys = sum(len(c["written"]) + len(c["read"])
+                 for c in contracts.values())
+    rc = report.emit(
+        "schemas", violations, args.json,
+        clean_note=f"schema contracts clean ({len(contracts)} families, "
+                   f"{n_keys} keys).",
+        extra={"families": len(contracts), "keys": n_keys})
+    if rc and not args.json:
         print(f"{len(violations)} schema-contract violation(s). "
               "Intentional evolution: `python -m raft_tpu.analysis "
               "schemas --write` and commit the diff.", file=sys.stderr)
-        return 1
-    n_keys = sum(len(c["written"]) + len(c["read"])
-                 for c in contracts.values())
-    print(f"schema contracts clean ({len(contracts)} families, "
-          f"{n_keys} keys).")
-    return 0
+    return rc
+
+
+def _cmd_protocol(args):
+    from raft_tpu.analysis import protocol, report
+
+    if args.mode == "extract":
+        sites, unmodeled = protocol.extract_all()
+        if args.json:
+            findings = [
+                {"file": s.path, "line": s.line, "col": s.col,
+                 "rule": ("protocol-unmodeled" if not s.modeled
+                          else "protocol-site"),
+                 "message": s.key, "action": s.action}
+                for s in sites]
+            report.emit("protocol", findings, True,
+                        extra={"mode": "extract",
+                               "unmodeled": len(unmodeled)})
+        else:
+            for s in sites:
+                mark = "!" if not s.modeled else " "
+                print(f"{mark} {s.key:58s} {s.action or 'UNMODELED':10s} "
+                      f"{s.path}:{s.line}")
+            print(f"{len(sites)} mutation site(s), "
+                  f"{len(unmodeled)} unmodeled.", file=sys.stderr)
+        return 1 if unmodeled else 0
+
+    if args.mode == "baseline":
+        if not args.write:
+            print("baseline is checked in; pass --write to re-pin "
+                  "(after an intentional protocol change)",
+                  file=sys.stderr)
+            return 2
+        try:
+            data = protocol.write_baseline()
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        print(f"protocol baseline written: {protocol.BASELINE_PATH} "
+              f"({len(data['sites'])} sites, "
+              f"{len(data['invariants'])} invariants)")
+        return 0
+
+    # mode == "check"
+    if args.fixture:
+        findings, stats = protocol.run_fixture(args.fixture)
+        if not findings:
+            print("protocol fixture produced NO findings — the engine "
+                  "is broken", file=sys.stderr)
+            return 2
+        rc = report.emit("protocol", findings, args.json,
+                         extra={"fixture": args.fixture, "stats": stats})
+        if not args.json:
+            print(f"{len(findings)} finding(s) (seeded fixture drill).",
+                  file=sys.stderr)
+        return rc
+    findings, stats = protocol.check(explore=not args.static_only)
+    rc = report.emit(
+        "protocol", findings, args.json,
+        clean_note="protocol model clean"
+        + ("" if args.static_only else
+           " (%d runs, %d states explored)" % (
+               sum(s.get("runs", 0) for s in stats.values()),
+               sum(s.get("states", 0) for s in stats.values()))) + ".",
+        extra={"stats": stats})
+    if rc and not args.json:
+        print(f"{len(findings)} protocol finding(s). Intentional "
+              "surface change: extend the mcheck model, then "
+              "`python -m raft_tpu.analysis protocol baseline --write`.",
+              file=sys.stderr)
+    return rc
 
 
 def _pin_cpu():
@@ -150,29 +221,57 @@ def main(argv=None):
     ap = argparse.ArgumentParser(prog="python -m raft_tpu.analysis")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    p = sub.add_parser("lint", help="run the trace-hygiene AST linter")
+    def _json_flag(parser):
+        parser.add_argument(
+            "--json", action="store_true",
+            help="emit one machine-readable JSON document instead of "
+                 "the human text format")
+        return parser
+
+    p = _json_flag(sub.add_parser(
+        "lint", help="run the trace-hygiene AST linter"))
     p.add_argument("paths", nargs="*", help="files to lint "
                    "(default: raft_tpu/ + bench.py + sweep_10k.py)")
 
-    p = sub.add_parser(
+    p = _json_flag(sub.add_parser(
         "concurrency",
         help="concurrency invariants: atomic-write, async-blocking, "
-             "lock-discipline, thread-hygiene")
+             "lock-discipline, thread-hygiene"))
     p.add_argument("paths", nargs="*",
                    help="files to analyze with every rule forced on "
                         "(default: the audited shared-state + serve "
                         "modules with per-module rule gating)")
 
-    p = sub.add_parser(
+    p = _json_flag(sub.add_parser(
         "schemas",
         help="cross-process writer/reader schema contracts vs the "
-             "checked-in analysis/schema_baseline.json")
+             "checked-in analysis/schema_baseline.json"))
     p.add_argument("--write", action="store_true",
                    help="regenerate the baseline (intentional schema "
                         "evolution; refuses over live drift)")
     p.add_argument("--fixture", action="store_true",
                    help="run the seeded drifted-lease fixture drill "
                         "(must exit 1 — the CI negative)")
+
+    p = _json_flag(sub.add_parser(
+        "protocol",
+        help="protocol model checker: static mutation-site extraction "
+             "vs analysis/protocol_baseline.json + exhaustive "
+             "interleaving/crash exploration of the fs state machines"))
+    p.add_argument("mode", choices=("check", "extract", "baseline"),
+                   help="check: diff sites vs baseline and explore; "
+                        "extract: list every mutation site; "
+                        "baseline: re-pin the site model (--write)")
+    p.add_argument("--write", action="store_true",
+                   help="with `baseline`: re-pin protocol_baseline.json "
+                        "(refuses over unmodeled sites)")
+    p.add_argument("--fixture", metavar="PATH",
+                   help="with `check`: drive the engines against a "
+                        "seeded-bug fixture module (must exit 1 — the "
+                        "CI negative)")
+    p.add_argument("--static-only", action="store_true",
+                   help="with `check`: skip the interleaving explorer "
+                        "(extraction diff only)")
 
     for name in ("contracts", "baseline"):
         p = sub.add_parser(
@@ -190,8 +289,9 @@ def main(argv=None):
 
     args = ap.parse_args(argv)
     cmd = {"lint": _cmd_lint, "concurrency": _cmd_concurrency,
-           "schemas": _cmd_schemas, "contracts": _cmd_contracts,
-           "baseline": _cmd_baseline, "flags": _cmd_flags}[args.cmd]
+           "schemas": _cmd_schemas, "protocol": _cmd_protocol,
+           "contracts": _cmd_contracts, "baseline": _cmd_baseline,
+           "flags": _cmd_flags}[args.cmd]
     return cmd(args)
 
 

@@ -45,7 +45,7 @@ import threading
 import time
 
 from raft_tpu.aot import bank
-from raft_tpu.utils import config
+from raft_tpu.utils import config, fsops
 from raft_tpu.utils.structlog import log_event
 
 RELEASES_DIRNAME = "releases"
@@ -178,10 +178,10 @@ def cut(label=None, flags_fp=None, promote_after=False):
     entries = snapshot_entries()
     man = build_manifest(entries, bank.code_fingerprint(), str(flags_fp),
                          parent=current_release(), label=label)
-    os.makedirs(releases_dir(), exist_ok=True)
-    bank._atomic_write(
+    fsops.makedirs(releases_dir(), exist_ok=True)
+    fsops.write_atomic(
         manifest_path(man["release"]),
-        (json.dumps(man, indent=1, sort_keys=True) + "\n").encode())
+        json.dumps(man, indent=1, sort_keys=True) + "\n")
     log_event("release_cut", release=man["release"], parent=man["parent"],
               entries=man["n_entries"], label=man["label"] or None)
     if promote_after:
@@ -196,8 +196,7 @@ def load_manifest(path):
     """Parse one manifest file; None when missing/garbled (a reader
     must never crash on a foreign file)."""
     try:
-        with open(path, encoding="utf-8") as f:
-            man = json.load(f)
+        man = json.loads(fsops.read_text(path))
     except (OSError, ValueError):
         return None
     return man if isinstance(man, dict) else None
@@ -212,7 +211,7 @@ def list_releases(aot_dir=None):
     d = releases_dir(aot_dir)
     out = []
     try:
-        names = sorted(os.listdir(d))
+        names = sorted(fsops.listdir(d))
     except OSError:
         return out
     for name in names:
@@ -229,8 +228,7 @@ def list_releases(aot_dir=None):
 def current_release(aot_dir=None):
     """The id the ``current`` pointer names, or None."""
     try:
-        with open(current_path(aot_dir), encoding="utf-8") as f:
-            rec = json.load(f)
+        rec = json.loads(fsops.read_text(current_path(aot_dir)))
         return str(rec["release"]) if isinstance(rec, dict) else None
     except (OSError, ValueError, KeyError):
         return None
@@ -328,11 +326,10 @@ def promote(release_id, aot_dir=None):
         raise ValueError(f"refusing to promote {release_id}: "
                          + "; ".join(problems))
     previous = current_release(aot_dir)
-    os.makedirs(releases_dir(aot_dir), exist_ok=True)
-    bank._atomic_write(
+    fsops.makedirs(releases_dir(aot_dir), exist_ok=True)
+    fsops.write_atomic(
         current_path(aot_dir),
-        (json.dumps({"release": str(release_id), "t": time.time()})
-         + "\n").encode())
+        json.dumps({"release": str(release_id), "t": time.time()}) + "\n")
     log_event("release_promote", release=str(release_id),
               previous=previous)
     return previous
@@ -360,17 +357,15 @@ def write_rollout_marker(from_id, to_id, aot_dir=None):
     """Mark a rolling upgrade in progress: BOTH releases are
     legitimate fleet members until the marker clears — the canary's
     provenance-consistency check reads this window."""
-    os.makedirs(releases_dir(aot_dir), exist_ok=True)
-    bank._atomic_write(
+    fsops.makedirs(releases_dir(aot_dir), exist_ok=True)
+    fsops.write_atomic(
         rollout_marker_path(aot_dir),
-        (json.dumps({"from": from_id, "to": to_id, "t": time.time()})
-         + "\n").encode())
+        json.dumps({"from": from_id, "to": to_id, "t": time.time()}) + "\n")
 
 
 def read_rollout_marker(aot_dir=None):
     try:
-        with open(rollout_marker_path(aot_dir), encoding="utf-8") as f:
-            rec = json.load(f)
+        rec = json.loads(fsops.read_text(rollout_marker_path(aot_dir)))
         return rec if isinstance(rec, dict) else None
     except (OSError, ValueError):
         return None
@@ -378,7 +373,7 @@ def read_rollout_marker(aot_dir=None):
 
 def clear_rollout_marker(aot_dir=None):
     try:
-        os.remove(rollout_marker_path(aot_dir))
+        fsops.unlink(rollout_marker_path(aot_dir))
         return True
     except OSError:
         return False

@@ -89,7 +89,7 @@ from raft_tpu.obs import metrics
 from raft_tpu.obs.heartbeat import maybe_heartbeat
 from raft_tpu.obs.spans import ambient_ids, propagation_env, span
 from raft_tpu.parallel import resilience
-from raft_tpu.utils import config, faults
+from raft_tpu.utils import config, faults, fsops
 from raft_tpu.utils.structlog import log_event
 
 FABRIC_DIRNAME = "_fabric"
@@ -173,11 +173,9 @@ def lease_claim(path, rec):
     """Exclusive lease creation: True when THIS caller won the
     ``O_CREAT|O_EXCL`` race and wrote ``rec``."""
     try:
-        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        fsops.create_exclusive(path, json.dumps(rec))
     except FileExistsError:
         return False
-    with os.fdopen(fd, "w") as f:
-        json.dump(rec, f)
     return True
 
 
@@ -186,32 +184,33 @@ def lease_read(path):
     absent.  A present-but-unreadable lease (claimant mid-write) reads
     as an empty record with the file's mtime."""
     try:
-        mtime = os.path.getmtime(path)
+        mtime = fsops.getmtime(path)
     except OSError:
         return None, None
     try:
-        with open(path) as f:
-            return json.load(f), mtime
+        return json.loads(fsops.read_text(path)), mtime
     except (OSError, ValueError):
         return {}, mtime
 
 
 def lease_rewrite(path, rec):
-    """Atomic full rewrite of a lease record (renewals)."""
-    resilience._atomic_write(path, lambda f: json.dump(rec, f), mode="w")
+    """Atomic full rewrite of a lease record (renewals): tmp write +
+    ``replace``, through the :mod:`~raft_tpu.utils.fsops` seam so the
+    protocol checker can crash an actor between the two halves."""
+    fsops.write_atomic(path, json.dumps(rec))
 
 
 def lease_remove(path):
     """Atomically remove a lease via rename to a unique grave: True
     when THIS caller won the rename (steal/evict — the losing racer
     sees False and must not double-count the removal)."""
-    grave = f"{path}.stolen.{uuid.uuid4().hex[:8]}"
+    grave = fsops.grave_name(path, "stolen")
     try:
-        os.rename(path, grave)
+        fsops.rename(path, grave)
     except OSError:
         return False
     try:
-        os.unlink(grave)
+        fsops.unlink(grave)
     except OSError:
         pass
     return True
@@ -247,8 +246,8 @@ class Ledger:
         self.worker_id = worker_id
         self.token = uuid.uuid4().hex
         for sub in ("leases", "done", "workers"):
-            os.makedirs(os.path.join(fabric_dir(out_dir), sub),
-                        exist_ok=True)
+            fsops.makedirs(os.path.join(fabric_dir(out_dir), sub),
+                           exist_ok=True)
 
     # -- leases
 
@@ -298,7 +297,7 @@ class Ledger:
         rec, _ = self.read_lease(shard)
         if rec and rec.get("token") == self.token:
             try:
-                os.unlink(_lease_path(self.out_dir, shard))
+                fsops.unlink(_lease_path(self.out_dir, shard))
             except OSError:
                 pass
 
@@ -327,7 +326,7 @@ class Ledger:
             return "expired", age, holder, attempt
         if holder:
             try:
-                st_m = os.path.getmtime(_worker_path(self.out_dir, holder))
+                st_m = fsops.getmtime(_worker_path(self.out_dir, holder))
                 if now - st_m > ttl:
                     return "holder_stale", now - st_m, holder, attempt
             except OSError:
@@ -383,15 +382,15 @@ class Ledger:
         """Every worker's last status record (unreadable files skipped)."""
         out = {}
         try:
-            names = os.listdir(_workers_dir(self.out_dir))
+            names = fsops.listdir(_workers_dir(self.out_dir))
         except OSError:
             return out
         for name in names:
             if not name.endswith(".json"):
                 continue
             try:
-                with open(os.path.join(_workers_dir(self.out_dir), name)) as f:
-                    out[name[:-5]] = json.load(f)
+                out[name[:-5]] = json.loads(fsops.read_text(
+                    os.path.join(_workers_dir(self.out_dir), name)))
             except (OSError, ValueError):
                 continue
         return out
@@ -432,7 +431,7 @@ class Ledger:
         from the lease renewer so a long shard keeps the holder's
         heartbeat fresh without a full status rewrite)."""
         try:
-            os.utime(_worker_path(self.out_dir, self.worker_id))
+            fsops.utime(_worker_path(self.out_dir, self.worker_id))
         except OSError:
             pass
 
@@ -792,7 +791,7 @@ class Worker:
             log_event("shard_corrupt", shard=s,
                       error=f"{path}: failed validation on claim")
             try:
-                os.unlink(path)
+                fsops.unlink(path)
             except OSError:
                 pass
         self.held.add(s)
@@ -851,7 +850,7 @@ def init_sweep(out_dir, entry, cases, out_keys, shard_size,
             f"got {lengths}")
     n = next(iter(lengths.values()))
     n_shards = (n + shard_size - 1) // shard_size
-    os.makedirs(fabric_dir(out_dir), exist_ok=True)
+    fsops.makedirs(fabric_dir(out_dir), exist_ok=True)
     fingerprint = resilience.compute_fingerprint(cases, out_keys,
                                                  shard_size, mesh=None)
     resilience.init_manifest(out_dir, fingerprint, n_shards)
@@ -922,7 +921,7 @@ def spawn_worker(out_dir, index=0, worker_id=None, env=None,
                 and s.strip().split(":")[0] not in ("worker_kill",
                                                     "lease_expire")]
         wenv[config.env_name("FAULTS")] = ",".join(kept)
-    os.makedirs(_workers_dir(out_dir), exist_ok=True)
+    fsops.makedirs(_workers_dir(out_dir), exist_ok=True)
     logf = open(os.path.join(_workers_dir(out_dir), f"{wid}.log"), "ab")
     try:
         proc = subprocess.Popen(

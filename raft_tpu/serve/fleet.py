@@ -49,7 +49,7 @@ from raft_tpu.obs import metrics
 from raft_tpu.parallel import resilience
 from raft_tpu.parallel.fabric import (lease_claim, lease_read,
                                       lease_remove, lease_rewrite)
-from raft_tpu.utils import config
+from raft_tpu.utils import config, fsops
 from raft_tpu.utils.structlog import log_event
 
 FLEET_DIRNAME = "_fleet"
@@ -82,8 +82,7 @@ def router_record_path(root):
 def read_router_record(root):
     """The router's last published membership record, or None."""
     try:
-        with open(router_record_path(root)) as f:
-            return json.load(f)
+        return json.loads(fsops.read_text(router_record_path(root)))
     except (OSError, ValueError):
         return None
 
@@ -115,7 +114,7 @@ class FleetLedger:
         signature fingerprint list; ``out_keys`` is the out_keys tuple
         this replica dispatches (the router canary intersects its probe
         keys with this — a probe asking for an unserved key is a 400)."""
-        os.makedirs(_replicas_dir(self.root), exist_ok=True)
+        fsops.makedirs(_replicas_dir(self.root), exist_ok=True)
         now = time.time()
         rec = {
             "replica": self.replica_id,
@@ -151,7 +150,7 @@ class FleetLedger:
         the token mismatch.  Outside a rollout, prefer :meth:`claim` —
         seizing a healthy stranger's lease is an operator error this
         method will happily commit."""
-        os.makedirs(_replicas_dir(self.root), exist_ok=True)
+        fsops.makedirs(_replicas_dir(self.root), exist_ok=True)
         prev, _ = self.read(self.replica_id)
         now = time.time()
         rec = {
@@ -211,7 +210,7 @@ class FleetLedger:
         """Every readable lease: ``{replica_id: (record, mtime)}``."""
         out = {}
         try:
-            names = os.listdir(_replicas_dir(self.root))
+            names = fsops.listdir(_replicas_dir(self.root))
         except OSError:
             return out
         for name in names:
@@ -352,7 +351,7 @@ def spawn_replica(root, designs_spec, index=0, replica_id=None,
         os.path.abspath(__file__))))
     old_pp = wenv.get("PYTHONPATH", "")
     wenv["PYTHONPATH"] = repo + (os.pathsep + old_pp if old_pp else "")
-    os.makedirs(_replicas_dir(root), exist_ok=True)
+    fsops.makedirs(_replicas_dir(root), exist_ok=True)
     logf = open(os.path.join(_replicas_dir(root), f"{rid}.log"), "ab")
     argv = [sys.executable, "-m", "raft_tpu.serve"]
     for spec in designs_spec:
@@ -461,5 +460,5 @@ def publish_router_record(root, rec):
     """Atomic write of the router's membership view (``router.json``)
     — the second `_fleet/` record family, read by ``fleet --status``
     and the drill assertions."""
-    os.makedirs(fleet_dir(root), exist_ok=True)
+    fsops.makedirs(fleet_dir(root), exist_ok=True)
     resilience._atomic_json(router_record_path(root), rec)

@@ -87,6 +87,41 @@ def _http_drain(addr, port, timeout_s=5.0):
         conn.close()
 
 
+def gate_decision(payload, baseline, need, replica=None, endpoint=None):
+    """Pure per-poll gate decision for one rollout step from one
+    ``/alerts`` payload snapshot: ``("green"|"red"|"pending",
+    reason)``.  Green requires ``need`` observations of the replaced
+    ``replica`` at its post-seize ``endpoint`` specifically (the
+    canary's per-replica observation run restarts on an endpoint
+    change, so its count is the new process's probe count); without a
+    replica/endpoint the gate falls back to fleet-wide fresh passes.
+    Factored out of :meth:`FleetOps.canary_verdict` so the protocol
+    model checker can interleave the REAL gate against the canary
+    state machine — the pre-PR-16 fleet-wide-pass race lives exactly
+    here."""
+    can = (payload or {}).get("canary")
+    if not can:
+        return "pending", "no-canary"
+    fails = int(can.get("fails") or 0) - baseline["fails"]
+    if fails > 0:
+        return "red", "canary-fail"
+    if not can.get("parity_ok", True):
+        return "red", "canary-parity"
+    active = (payload or {}).get("active") or []
+    if active:
+        names = sorted(a.get("rule") or "?" for a in active)
+        return "red", "alert:" + ",".join(names)
+    if replica is not None and endpoint:
+        run = (can.get("probes") or {}).get(str(replica)) or {}
+        fresh = (int(run.get("n") or 0)
+                 if run.get("endpoint") == str(endpoint) else 0)
+    else:
+        fresh = int(can.get("passes") or 0) - baseline["passes"]
+    if fresh >= need:
+        return "green", f"canary-green({fresh})"
+    return "pending", "waiting"
+
+
 class FleetOps:
     """The rollout driver's side-effect seam against a real fleet:
     ledger reads, takeover spawns, drain POSTs and router-canary
@@ -167,28 +202,11 @@ class FleetOps:
         deadline = time.monotonic() + float(timeout_s)
         while time.monotonic() < deadline:
             payload = _http_get_json(self.router_url, "/alerts")
-            can = (payload or {}).get("canary")
-            if can:
-                fails = int(can.get("fails") or 0) - baseline["fails"]
-                if fails > 0:
-                    return False, "canary-fail"
-                if not can.get("parity_ok", True):
-                    return False, "canary-parity"
-                active = (payload or {}).get("active") or []
-                if active:
-                    names = sorted(a.get("rule") or "?" for a in active)
-                    return False, "alert:" + ",".join(names)
-                if replica is not None and endpoint:
-                    run = (can.get("probes") or {}) \
-                        .get(str(replica)) or {}
-                    fresh = (int(run.get("n") or 0)
-                             if run.get("endpoint") == str(endpoint)
-                             else 0)
-                else:
-                    fresh = int(can.get("passes") or 0) \
-                        - baseline["passes"]
-                if fresh >= need:
-                    return True, f"canary-green({fresh})"
+            verdict, reason = gate_decision(payload, baseline, need,
+                                            replica=replica,
+                                            endpoint=endpoint)
+            if verdict != "pending":
+                return verdict == "green", reason
             time.sleep(self.poll_s)
         return False, "canary-timeout"
 

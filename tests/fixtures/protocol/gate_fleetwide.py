@@ -1,0 +1,40 @@
+"""Seeded protocol bug: the pre-PR-16 rollout-gate fleet-wide-pass race.
+
+Before the gate was keyed to the replaced replica's post-seize
+observation run, it counted *fleet-wide* fresh canary passes: probes
+of neighbor replicas (or of the candidate at its pre-seize endpoint)
+could satisfy ``need`` and turn the gate green before the upgraded
+process had ever answered a probe.
+
+The model checker must catch this through the gate-candidate-probed
+invariant in the rollout-gate scenario.  ``python -m raft_tpu.analysis
+protocol check --fixture <this file>`` must exit 1.
+"""
+
+
+def gate_decision(payload, baseline, need, replica=None, endpoint=None):
+    # the historical gate: replica/endpoint accepted but IGNORED —
+    # any fresh pass anywhere in the fleet counts toward `need`.
+    can = (payload or {}).get("canary")
+    if not can:
+        return "pending", "no-canary"
+    fails = int(can.get("fails") or 0) - baseline["fails"]
+    if fails > 0:
+        return "red", "canary-fail"
+    if not can.get("parity_ok", True):
+        return "red", "canary-parity"
+    active = (payload or {}).get("active") or []
+    if active:
+        names = sorted(a.get("rule") or "?" for a in active)
+        return "red", "alert:" + ",".join(names)
+    fresh = int(can.get("passes") or 0) - baseline["passes"]
+    if fresh >= need:
+        return "green", f"canary-green({fresh})"
+    return "pending", "waiting"
+
+
+PATCHES = {
+    "raft_tpu.serve.rollout:gate_decision": gate_decision,
+}
+
+SCENARIOS = ("rollout-gate",)

@@ -6,18 +6,16 @@ Physics checks against closed-form potential-flow results:
 * symmetry of the added-mass matrix.
 """
 
-import shutil
-
 import numpy as np
 import pytest
 
 from raft_tpu.io.panels import mesh_cylinder, write_pnl
+from conftest import require_native_env
 
 
 @pytest.fixture(scope="module")
-def spar_mesh():
-    if shutil.which("g++") is None:
-        pytest.skip("no C++ toolchain")
+def spar_mesh(native_bem_env):
+    require_native_env(native_bem_env, "native")
     # vertical cylinder: radius 5 m, draft 60 m
     return mesh_cylinder(
         stations=[0.0, 60.0], diameters=[10.0, 10.0],
@@ -67,7 +65,7 @@ HAMS_FIXTURE = "/root/reference/raft/data/cylinder"
 
 
 @pytest.mark.slow
-def test_frequency_solver_vs_hams_fixture():
+def test_frequency_solver_vs_hams_fixture(native_bem_env):
     """Radiation A/B and excitation X vs the reference's shipped HAMS
     run (raft/data/cylinder: 1008-panel floating cylinder, depth 50,
     WAMIT-format outputs).  The native solver reads the SAME mesh, so
@@ -77,10 +75,9 @@ def test_frequency_solver_vs_hams_fixture():
     from raft_tpu.io.panels import read_pnl
     from raft_tpu.native import solve_bem
 
-    if shutil.which("g++") is None:
-        pytest.skip("no C++ toolchain")
+    require_native_env(native_bem_env, "native", "reference")
     if not os.path.exists(HAMS_FIXTURE):
-        pytest.skip("fixture unavailable")
+        pytest.skip("HAMS cylinder fixture unavailable")
     v, c, nrm, a = read_pnl(os.path.join(HAMS_FIXTURE, "Input", "HullMesh.pnl"))
     gold1 = np.loadtxt(os.path.join(HAMS_FIXTURE, "Output", "Wamit_format", "Buoy.1"))
     gold3 = np.loadtxt(os.path.join(HAMS_FIXTURE, "Output", "Wamit_format", "Buoy.3"))
@@ -117,7 +114,7 @@ def test_frequency_solver_vs_hams_fixture():
 
 
 @pytest.mark.slow
-def test_oc4semi_potmod2_end_to_end(tmp_path):
+def test_oc4semi_potmod2_end_to_end(tmp_path, native_bem_env):
     """OC4semi runs potModMaster=2 END TO END with NO pre-existing
     coefficient files: members are auto-meshed, the native panel solver
     produces A/B/X through the WAMIT interchange round trip, and the
@@ -130,8 +127,7 @@ def test_oc4semi_potmod2_end_to_end(tmp_path):
     from raft_tpu.io.wamit import read_wamit1
     from raft_tpu.structure.schema import load_design
 
-    if shutil.which("g++") is None:
-        pytest.skip("no C++ toolchain")
+    require_native_env(native_bem_env, "native", "reference")
     design = load_design("/root/reference/designs/OC4semi.yaml")
     design["platform"]["potModMaster"] = 2
     design["settings"]["min_freq"] = 0.01
@@ -171,7 +167,7 @@ def test_oc4semi_potmod2_end_to_end(tmp_path):
     assert np.isfinite(np.asarray(Xi)).all()
 
 
-def test_interior_panel_removal():
+def test_interior_panel_removal(native_bem_env):
     """Panels buried inside an intersecting member are removed (the
     functional effect of the reference's boolean-union
     IntersectionMesh); surface panels survive."""
@@ -179,6 +175,7 @@ def test_interior_panel_removal():
     from raft_tpu.io.panels import mesh_fowt
     from raft_tpu.structure.schema import load_design
 
+    require_native_env(native_bem_env, "reference")
     design = load_design("/root/reference/designs/OC4semi.yaml")
     design["platform"]["potModMaster"] = 2
     design["settings"]["nAz_BEM"] = 8
@@ -240,7 +237,7 @@ def test_fd_mode_count_tracks_panel_spacing():
 
 
 @pytest.mark.slow
-def test_fd_solver_shallow_energy_relation():
+def test_fd_solver_shallow_energy_relation(native_bem_env):
     """Genuinely shallow water (depth 12 m, K h ~ 0.5-2): the
     finite-depth solver's radiation damping satisfies the
     finite-depth Haskind energy relation
@@ -263,8 +260,7 @@ def test_fd_solver_shallow_energy_relation():
     from raft_tpu.native import solve_bem_frequency
     from raft_tpu.native.green_fd import dispersion_roots
 
-    if shutil.which("g++") is None:
-        pytest.skip("no C++ toolchain")
+    require_native_env(native_bem_env, "native")
     h = 12.0
     verts, cents, norms, areas = mesh_cylinder(
         stations=[0.0, 6.0], diameters=[8.0, 8.0],

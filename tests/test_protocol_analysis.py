@@ -1,0 +1,234 @@
+"""Unit tests for the protocol model checker: the static
+mutation-site extraction (:mod:`raft_tpu.analysis.protocol`), the
+interleaving explorer (:mod:`raft_tpu.analysis.mcheck`), the seeded
+historical-race fixtures, the ``--json`` CLI surface, and direct
+crash-window tests of the two atomic flips everything else leans on
+(fabric lease rewrite, release pointer promote).
+
+The explorer subsets used here are the cheap ones (the full five-
+scenario sweep runs in lint.sh via ``protocol check``); the fixture
+drills stop at the first violation and finish in well under a second.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from raft_tpu.analysis import mcheck, protocol
+from raft_tpu.utils import fsops
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "fixtures", "protocol")
+
+
+def fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+# ------------------------------------------------------- static extraction
+
+
+def test_extraction_covers_protocol_surface():
+    sites, unmodeled = protocol.extract_all()
+    assert unmodeled == []
+    keys = {s.key for s in sites}
+    # the load-bearing actions must be modeled exactly where they live
+    assert "fabric::lease_claim::fsops.create_exclusive" in keys
+    assert "fabric::lease_rewrite::fsops.write_atomic" in keys
+    assert "fleet::FleetLedger.seize::lease_rewrite" in keys
+    assert "release::promote::fsops.write_atomic" in keys
+    assert "release::clear_rollout_marker::fsops.unlink" in keys
+    # rollout/router/canary coordinate through fleet/release/fsops —
+    # they must own NO direct mutation sites of their own
+    assert not any(s.module in ("rollout", "router", "canary")
+                   for s in sites)
+
+
+def test_action_classification():
+    sites, _ = protocol.extract_all()
+    by_key = {s.key: s.action for s in sites}
+    assert by_key["fabric::Ledger.claim::lease_claim"] == "claim"
+    assert by_key["fabric::Ledger.steal::lease_remove"] == "steal"
+    assert by_key["fleet::FleetLedger.seize::lease_rewrite"] == "seize"
+    assert by_key["fleet::FleetLedger.evict::lease_remove"] == "evict"
+    assert by_key["release::promote::fsops.write_atomic"] == "promote"
+    assert by_key["fabric::Ledger.touch_worker::fsops.utime"] \
+        == "heartbeat"
+    assert by_key["fabric::spawn_worker::open[ab]"] == "append-log"
+
+
+def test_baseline_roundtrip_clean():
+    """The checked-in baseline matches a fresh extraction exactly."""
+    sites, unmodeled = protocol.extract_all()
+    baseline = protocol.load_baseline()
+    assert protocol.sites_to_model(sites) == baseline["sites"]
+    assert sorted(baseline["invariants"]) == sorted(mcheck.INVARIANTS)
+    assert protocol.diff_against_baseline(sites, unmodeled,
+                                          baseline) == []
+
+
+def test_static_check_clean():
+    findings, _ = protocol.check(explore=False)
+    assert findings == []
+
+
+def test_drift_detected():
+    sites, unmodeled = protocol.extract_all()
+    baseline = protocol.load_baseline()
+    mutated = {"schema": baseline["schema"],
+               "invariants": baseline["invariants"],
+               "sites": dict(baseline["sites"])}
+    (dropped, ent) = sorted(mutated["sites"].items())[0]
+    del mutated["sites"][dropped]
+    mutated["sites"]["fabric::ghost::fsops.unlink"] = {
+        "action": "release", "count": 1}
+    found = protocol.diff_against_baseline(sites, unmodeled, mutated)
+    msgs = [f.message for f in found]
+    assert all(f.rule == "protocol-drift" for f in found)
+    assert any(dropped in m and "not in baseline" in m for m in msgs)
+    assert any("fabric::ghost::fsops.unlink" in m and "vanished" in m
+               for m in msgs)
+
+
+# ----------------------------------------------------- seeded race drills
+
+
+def test_unmodeled_fixture_caught():
+    findings, _ = protocol.run_fixture(fixture("unmodeled_site.py"))
+    assert findings
+    assert {f.rule for f in findings} == {"protocol-unmodeled"}
+    assert any("os.rename" in f.message for f in findings)
+
+
+def test_claim_hijack_fixture_caught():
+    """The pre-PR-13 exists-then-write claim is a single-holder
+    violation on its very first interleaving."""
+    findings, _ = protocol.run_fixture(fixture("claim_hijack.py"))
+    assert any(f.rule == "protocol-single-holder" for f in findings)
+
+
+def test_gate_fleetwide_fixture_caught():
+    """The pre-PR-16 fleet-wide gate goes green off neighbor probes."""
+    findings, _ = protocol.run_fixture(fixture("gate_fleetwide.py"))
+    assert any(f.rule == "protocol-gate-candidate-probed"
+               for f in findings)
+
+
+def test_release_pointer_scenario_clean():
+    violations, stats = mcheck.run_all(
+        scenarios=[mcheck.ReleasePointerScenario])
+    assert violations == []
+    assert stats["release-pointer"]["runs"] > 0
+
+
+# ------------------------------------------------- crash-window contracts
+
+
+def _crashing_replace(monkeypatch):
+    def boom(src, dst):
+        raise OSError("injected crash before pointer flip")
+    monkeypatch.setattr(fsops, "replace", boom)
+
+
+def test_lease_rewrite_crash_window(tmp_path, monkeypatch):
+    """A renewer dying between tmp-write and replace must leave the
+    prior lease record fully readable and no tmp debris behind."""
+    from raft_tpu.parallel import fabric
+
+    path = str(tmp_path / "lease.json")
+    assert fabric.lease_claim(path, {"worker": "w1", "token": "t1"})
+    _crashing_replace(monkeypatch)
+    with pytest.raises(OSError):
+        fabric.lease_rewrite(path, {"worker": "w1", "token": "t2"})
+    rec, mtime = fabric.lease_read(path)
+    assert rec == {"worker": "w1", "token": "t1"}
+    assert mtime is not None
+    assert [n for n in os.listdir(tmp_path) if ".tmp." in n] == []
+
+
+def test_promote_crash_window(tmp_path, monkeypatch):
+    """A promoter dying at the pointer flip must leave ``current``
+    resolving to the previous verified release."""
+    from raft_tpu.aot import release
+
+    aot = str(tmp_path)
+    man1 = release.build_manifest({}, "code", "flags")
+    man2 = release.build_manifest({}, "code", "flags",
+                                  parent=man1["release"])
+    fsops.makedirs(release.releases_dir(aot))
+    for man in (man1, man2):
+        fsops.write_atomic(release.manifest_path(man["release"], aot),
+                           json.dumps(man, sort_keys=True))
+    release.promote(man1["release"], aot)
+
+    _crashing_replace(monkeypatch)
+    with pytest.raises(OSError):
+        release.promote(man2["release"], aot)
+    rid, man = release.resolve(aot)
+    assert rid == man1["release"]
+    assert man is not None and release.verify_manifest(man) == []
+    assert [n for n in os.listdir(release.releases_dir(aot))
+            if ".tmp." in n] == []
+
+
+def test_tmp_and_grave_leftovers_never_live(tmp_path):
+    """Stray tmp/grave debris in the replicas dir (a crashed renewer
+    or loser of a steal race) must never surface as membership."""
+    from raft_tpu.serve import fleet
+
+    root = str(tmp_path)
+    led = fleet.FleetLedger(root, replica_id="r0")
+    assert led.claim(7001)
+    lease = os.path.join(root, "_fleet", "replicas", "r0.json")
+    with open(lease + ".tmp.x.1", "w") as f:
+        f.write("{torn")
+    with open(lease + ".stolen.x.2", "w") as f:
+        f.write("{}")
+    assert set(led.replicas()) == {"r0"}
+    assert set(led.live()) == {"r0"}
+
+
+# ----------------------------------------------------------- CLI surface
+
+
+def test_cli_static_json_clean():
+    out = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "protocol",
+         "check", "--static-only", "--json"],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["engine"] == "protocol"
+    assert doc["clean"] is True and doc["findings"] == []
+
+
+def test_cli_fixture_exit_code_and_records():
+    out = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "protocol",
+         "check", "--fixture", fixture("unmodeled_site.py"), "--json"],
+        cwd=REPO, capture_output=True, text=True)
+    assert out.returncode == 1, out.stderr
+    doc = json.loads(out.stdout)
+    recs = doc["findings"]
+    assert recs and all(
+        set(r) >= {"file", "line", "col", "rule", "message"}
+        for r in recs)
+
+
+def test_explorer_is_jax_free():
+    """The model checker must stay importable and runnable without jax
+    (it is a pre-commit gate; backend init can hang under plugins)."""
+    code = (
+        "import sys\n"
+        "from raft_tpu.analysis import mcheck\n"
+        "v, s = mcheck.run_all("
+        "scenarios=[mcheck.ReleasePointerScenario])\n"
+        "assert not v, v\n"
+        "assert 'jax' not in sys.modules, 'jax leaked into explorer'\n")
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
