@@ -117,6 +117,22 @@ python -m raft_tpu.obs trace --merge tests/fixtures/obs \
 python -m raft_tpu.obs trace --merge tests/fixtures/obs_router \
     -o /tmp/raft_obs_router_merge_check.json --check > /dev/null
 
+# flight-recorder shards: the checked-in valid dump must pass `obs
+# flight show` (exit 0: schema-versioned anchor, every record
+# stamped) and the truncated twin — the torn write an atomic dumper
+# can never produce — must be refused with EXACTLY exit 1 (trusting a
+# damaged postmortem is worse than having none)
+python -m raft_tpu.obs flight show tests/fixtures/flight/valid.jsonl \
+    > /dev/null
+flight_rc=0
+python -m raft_tpu.obs flight show tests/fixtures/flight/truncated.jsonl \
+    > /dev/null 2>&1 || flight_rc=$?
+if [ "$flight_rc" -ne 1 ]; then
+    echo "lint.sh: obs flight show exited $flight_rc on the truncated" \
+         "shard fixture (want 1: damage refused)" >&2
+    exit 1
+fi
+
 # alert-rule engine: the default rule pack (+ any RAFT_TPU_ALERT_RULES
 # override) must validate, the clean run-record fixture must replay
 # with no rule firing (exit 0), and the seeded alerting fixture (SLO

@@ -124,6 +124,12 @@ def _enforce_env_budget():
     metrics.counter("compile_budget_exceeded").inc()
     log_event("compile_budget_exceeded", count=PROCESS_LOG.real_count,
               budget=budget, action=action)
+    # a breach is a postmortem moment: persist the flight ring (what
+    # dispatched, which signatures, what the caches did) before the
+    # raise can unwind the process
+    from raft_tpu.obs import flight
+
+    flight.dump(trigger="compile-budget")
     if action == "error":
         raise RecompilationError(
             f"backend compilation #{PROCESS_LOG.real_count} exceeds "

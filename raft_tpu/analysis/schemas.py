@@ -102,6 +102,7 @@ _ALERTS = "raft_tpu/obs/alerts.py"
 _CANARY = "raft_tpu/serve/canary.py"
 _RELEASE = "raft_tpu/aot/release.py"
 _ROLLOUT = "raft_tpu/serve/rollout.py"
+_FLIGHT = "raft_tpu/obs/flight.py"
 
 FAMILIES: tuple[Family, ...] = (
     Family(
@@ -228,6 +229,15 @@ FAMILIES: tuple[Family, ...] = (
         "+ the rollout CLI/drill summary — raft_tpu.serve.rollout)",
         writers=(Site(_ROLLOUT, "build_record", "record"),),
         readers=(Site(_ROLLOUT, "summarize_record", "record"),)),
+    Family(
+        "flight-dump",
+        "flight-recorder dump shard header (flight-*.jsonl first line: "
+        "a proc_start clock anchor carrying the schema-versioned "
+        "flight metadata block — raft_tpu.obs.flight; the shard body "
+        "reuses the live structlog event layout)",
+        writers=(Site(_FLIGHT, "_header_record", "rec"),),
+        readers=(Site(_FLIGHT, "read_shard", "hdr"),
+                 Site(_FLIGHT, "show", "hdr"))),
 )
 
 

@@ -6,8 +6,10 @@
     python -m raft_tpu.obs trace  --merge <capture-dir | shards...> -o t.json
     python -m raft_tpu.obs events
     python -m raft_tpu.obs spans
+    python -m raft_tpu.obs report <capture> --tail [RANK]
     python -m raft_tpu.obs runs   {record,list,compare,regress,ingest,pin}
     python -m raft_tpu.obs alerts {list,check,eval}
+    python -m raft_tpu.obs flight {dump,show}
 
 ``report`` prints the per-stage wall-time tree, counter table, program
 cost ledger, serve tail-attribution and padding-waste tables and the
@@ -22,6 +24,10 @@ server onto ONE wall-clock timeline using the per-process
 when the merged capture has unmatched span begins or orphan spans (a
 parent id resolving to no span) — the cross-process propagation
 acceptance gate.  ``events``/``spans`` list the registered schemas.
+``report --tail`` renders THE request at a latency rank (default p95)
+— its exemplar identity, stage decomposition and full span tree;
+``flight`` dumps/validates the black-box recorder's shards
+(:mod:`raft_tpu.obs.flight` — a damaged shard exits 1).
 
 ``alerts`` is the live fleet-health layer's offline face
 (:mod:`raft_tpu.obs.alerts`): ``list`` prints the effective rule pack
@@ -77,6 +83,16 @@ def _cmd_report(args):
     from raft_tpu.obs import report
 
     events, bad, _ = _load(args.jsonl, args.merge)
+    if args.tail is not None:
+        source = ", ".join(args.jsonl)
+        if args.format == "json":
+            json.dump(report.tail_view(events, rank=args.tail),
+                      sys.stdout, indent=1, default=str)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(report.render_tail(events, rank=args.tail,
+                                                source=source))
+        return 0
     if args.format == "json":
         json.dump(report.report_data(events, bad,
                                      source=", ".join(args.jsonl)),
@@ -129,6 +145,33 @@ def _cmd_spans(_args):
     for name, help_ in ev.describe_spans():
         print(f"{name:32s} {help_}")
     return 0
+
+
+# ----------------------------------------------------------- flight verbs
+
+
+def _cmd_flight_dump(args):
+    """Persist THIS process's flight ring as one shard.  Mostly useful
+    in-process (the ring is per-process); from the CLI it documents the
+    dump format and gives scripts a deterministic writer."""
+    from raft_tpu.obs import flight
+
+    path = flight.dump(trigger=args.trigger, path=args.output)
+    if path is None:
+        print("flight dump: nowhere to write — pass -o PATH or set "
+              "RAFT_TPU_FLIGHT_DIR (and RAFT_TPU_FLIGHT_RING > 0)",
+              file=sys.stderr)
+        return 2
+    print(f"{path}: flight shard written (trigger={args.trigger})")
+    return 0
+
+
+def _cmd_flight_show(args):
+    """Validate + summarize one dump shard; exit 1 on a damaged or
+    newer-schema shard (the lint.sh gate)."""
+    from raft_tpu.obs import flight
+
+    return flight.show(args.shard)
 
 
 # ----------------------------------------------------------- alerts verbs
@@ -426,6 +469,11 @@ def main(argv=None):
     p.add_argument("--format", choices=("text", "json"), default="text",
                    help="'json' emits every report section machine-"
                         "readably (the run-record 'report' payload)")
+    p.add_argument("--tail", nargs="?", const=0.95, default=None,
+                   type=float, metavar="RANK",
+                   help="render THE request at this latency rank "
+                        "(default p95): its exemplar identity, stage "
+                        "decomposition and full span tree")
 
     p = sub.add_parser("trace",
                        help="export a capture as Chrome trace events")
@@ -443,6 +491,27 @@ def main(argv=None):
 
     sub.add_parser("events", help="list the registered event schema")
     sub.add_parser("spans", help="list the registered span names")
+
+    p = sub.add_parser("flight",
+                       help="black-box flight recorder: dump this "
+                            "process's ring, validate/summarize shards "
+                            "(raft_tpu.obs.flight)")
+    fsub = p.add_subparsers(dest="flight_cmd", required=True)
+
+    f = fsub.add_parser("dump", help="persist the in-process ring as "
+                                     "one schema-versioned JSONL shard")
+    f.add_argument("-o", "--output", default=None,
+                   help="output path (default: RAFT_TPU_FLIGHT_DIR/"
+                        "flight-<pid>-<trigger>.jsonl)")
+    f.add_argument("--trigger", default="manual",
+                   help="trigger tag for the header + filename "
+                        "(default manual)")
+
+    f = fsub.add_parser("show",
+                        help="strictly validate + summarize one dump "
+                             "shard (exit 1 on a damaged/truncated/"
+                             "newer-schema shard — the lint.sh gate)")
+    f.add_argument("shard", help="a flight-*.jsonl dump shard")
 
     p = sub.add_parser("alerts",
                        help="alert-rule engine: list/check the rule "
@@ -529,6 +598,9 @@ def main(argv=None):
     if args.cmd == "alerts":
         return {"list": _cmd_alerts_list, "check": _cmd_alerts_check,
                 "eval": _cmd_alerts_eval}[args.alerts_cmd](args)
+    if args.cmd == "flight":
+        return {"dump": _cmd_flight_dump,
+                "show": _cmd_flight_show}[args.flight_cmd](args)
     return {"report": _cmd_report, "trace": _cmd_trace,
             "events": _cmd_events, "spans": _cmd_spans}[args.cmd](args)
 

@@ -474,6 +474,12 @@ class AlertEngine:
                           severity=rec["severity"], metric=rec["metric"],
                           value=rec["value"], threshold=rec["threshold"],
                           context=rec["context"])
+                # black-box the moments before the fire: the triggering
+                # rule lands in the dump filename (alert-<rule>), so a
+                # fleet's FLIGHT_DIR reads as a postmortem index
+                from raft_tpu.obs import flight
+
+                flight.dump(trigger=f"alert-{rec['rule']}")
             else:
                 metrics.counter("alerts_resolved").inc()
                 log_event("alert_resolve", rule=rec["rule"],

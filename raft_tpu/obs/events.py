@@ -436,6 +436,30 @@ EVENTS: dict[str, tuple[tuple[str, ...], str]] = {
         ("count", "budget", "action"),
         "a backend compilation exceeded RAFT_TPU_COMPILE_BUDGET; "
         "action 'error' raised RecompilationError at the dispatch"),
+    # -------------------------------------------------- flight recorder
+    "flight_dump": (
+        ("trigger", "path", "records"),
+        "the black-box flight ring was persisted as one atomic JSONL "
+        "shard (raft_tpu.obs.flight): trigger names the cause — an "
+        "alert dump embeds the firing rule (alert-<rule>), plus "
+        "quarantine-severe / compile-budget / crash-<exc> / sigterm / "
+        "manual; `obs flight show` summarizes the shard and `obs trace "
+        "--merge` places it on the shared timeline"),
+    "flight_metrics": (
+        ("counters",),
+        "periodic metric-snapshot delta record inside a flight-dump "
+        "shard (never emitted to the live stream): the counter "
+        "movement since the previous flight snapshot "
+        "(RAFT_TPU_FLIGHT_SNAP_S) — rate context for a postmortem"),
+    "exemplar_recorded": (
+        ("metric", "value"),
+        "a histogram observation was admitted to a top-K-per-bucket "
+        "exemplar slot (raft_tpu.obs.metrics): the free-form rest of "
+        "the payload carries the caller-stamped attrs — trace/span "
+        "ids, design content hash, bucket signature, dispatched rows, "
+        "cache-hit bit, replica id, int32 status word — and is the "
+        "join key `obs report --tail` uses to render the actual tail "
+        "request's span tree"),
     # -------------------------------------------------- device-cost ledger
     "program_cost": (
         ("kind", "key", "source", "flops?", "bytes_accessed?",

@@ -34,17 +34,30 @@ from raft_tpu.utils.structlog import log_event
 _MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
              "largest_free_block_bytes")
 
+#: the procfs status file the RSS sampler reads — module-level so tests
+#: (and exotic hosts) can point it elsewhere
+PROC_STATUS_PATH = "/proc/self/status"
+
+# one-shot availability memo: a host without procfs (macOS, some
+# sandboxes) fails the FIRST open and is never probed again — the rss
+# gauges simply stay absent, with no per-beat reopen or warning spam
+_PROC_AVAILABLE = [True]
+
 
 def sample_host_rss():
     """``(rss_bytes, peak_bytes)`` of THIS process from
     ``/proc/self/status`` (``VmRSS``/``VmHWM`` — no psutil dependency):
     the host-side memory picture device ``memory_stats()`` cannot see
     (packed design pytrees, result caches, the CPU backend's arrays all
-    live in host RSS).  ``(None, None)`` on non-Linux hosts."""
+    live in host RSS).  ``(None, None)`` on hosts without procfs —
+    permanently, after one failed open."""
+    if not _PROC_AVAILABLE[0]:
+        return None, None
     try:
-        with open("/proc/self/status") as f:
+        with open(PROC_STATUS_PATH) as f:
             text = f.read()
     except OSError:
+        _PROC_AVAILABLE[0] = False
         return None, None
 
     def field(name):

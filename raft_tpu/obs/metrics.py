@@ -30,10 +30,38 @@ import threading
 import time
 from collections import deque
 
+from raft_tpu.utils import config
+
 _T0 = time.perf_counter()
 
 # fixed log-spaced bucket upper bounds: 10^(-6) .. 10^7, 4 per decade
 BUCKET_BOUNDS = tuple(10.0 ** (e / 4.0) for e in range(-24, 29))
+
+
+def _exemplar_limits():
+    """(K, min_value) admission policy for histogram/window exemplars,
+    re-read per observation so tests and operators can retune live."""
+    try:
+        k = int(config.get("EXEMPLAR_K"))
+    except ValueError:
+        k = 2
+    try:
+        vmin = float(config.get("EXEMPLAR_MIN_S"))
+    except ValueError:
+        vmin = 0.0
+    return k, vmin
+
+
+def _emit_exemplar_event(metric, v, labels):
+    """One ``exemplar_recorded`` event per *admitted* exemplar — the
+    join key ``obs report --tail`` uses to find "the actual p99
+    request" in a capture.  Called outside the metric lock (log_event
+    takes the sink lock; never hold both).  Lazy import: metrics must
+    stay importable standalone."""
+    from raft_tpu.utils import structlog
+
+    structlog.log_event("exemplar_recorded", metric=metric,
+                        value=round(float(v), 6), **labels)
 
 
 class Counter:
@@ -93,7 +121,8 @@ class Histogram:
     """Fixed log-spaced-bucket histogram with count/sum/min/max and
     bucket-interpolated percentile estimates."""
 
-    __slots__ = ("name", "_lock", "count", "sum", "min", "max", "_buckets")
+    __slots__ = ("name", "_lock", "count", "sum", "min", "max", "_buckets",
+                 "_exemplars")
 
     def __init__(self, name):
         self.name = name
@@ -104,10 +133,17 @@ class Histogram:
         self.max = None  # raft-lint: guarded-by=self._lock
         # len(BUCKET_BOUNDS) + 1: trailing overflow bucket (+inf)
         self._buckets = [0] * (len(BUCKET_BOUNDS) + 1)  # raft-lint: guarded-by=self._lock
+        # bucket index -> up to K (value, unix_t, labels) kept largest-
+        # first, so "the actual p99 request" is nameable from /metrics
+        self._exemplars: dict = {}  # raft-lint: guarded-by=self._lock
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
+        """Record ``v``; ``exemplar`` (a small dict of label strings —
+        trace/span ids plus caller attrs) competes for one of the
+        top-K-by-value exemplar slots of ``v``'s log-bucket."""
         v = float(v)
         i = bisect.bisect_left(BUCKET_BOUNDS, v)
+        admitted = False
         with self._lock:
             self.count += 1
             self.sum += v
@@ -116,6 +152,33 @@ class Histogram:
             if self.max is None or v > self.max:
                 self.max = v
             self._buckets[i] += 1
+            if exemplar is not None:
+                # top-K-by-value admission for bucket i
+                k, vmin = _exemplar_limits()
+                if k > 0 and v >= vmin:
+                    slot = self._exemplars.setdefault(i, [])
+                    entry = (v, time.time(), dict(exemplar))
+                    if len(slot) < k:
+                        slot.append(entry)
+                        admitted = True
+                    else:
+                        jmin = min(range(len(slot)),
+                                   key=lambda j: slot[j][0])
+                        if v > slot[jmin][0]:
+                            slot[jmin] = entry
+                            admitted = True
+        if admitted:
+            # outside the lock: log_event takes the sink lock, and the
+            # two must never nest
+            _emit_exemplar_event(self.name, v, exemplar)
+
+    def exemplars(self):
+        """``{bucket_index: (value, unix_t, labels)}`` — the single
+        best (largest) exemplar per occupied bucket, for the
+        OpenMetrics exporter."""
+        with self._lock:
+            return {i: max(slot, key=lambda e: e[0])
+                    for i, slot in self._exemplars.items() if slot}
 
     def percentile(self, p):
         """Estimated p-quantile (0..1) from the bucket counts: the
@@ -233,19 +296,42 @@ class Window:
 
     DEFAULT_WINDOW_S = 60.0
 
-    __slots__ = ("name", "_lock", "_buf", "total")
+    __slots__ = ("name", "_lock", "_buf", "total", "_ex")
 
     def __init__(self, name, maxlen=4096):
         self.name = name
         self._lock = threading.Lock()
         self._buf = deque(maxlen=int(maxlen))  # raft-lint: guarded-by=self._lock
         self.total = 0  # lifetime count  # raft-lint: guarded-by=self._lock
+        # exemplar'd samples (t, value, labels): bounded ring; pruned
+        # to the window on read, ranked on demand by tail_exemplars()
+        self._ex = deque(maxlen=256)  # raft-lint: guarded-by=self._lock
 
-    def observe(self, v, t=None):
+    def observe(self, v, t=None, exemplar=None):
         t = time.perf_counter() if t is None else float(t)
         with self._lock:
             self._buf.append((t, float(v)))
             self.total += 1
+            if exemplar is not None:
+                k, vmin = _exemplar_limits()
+                if k > 0 and float(v) >= vmin:
+                    self._ex.append((t, float(v), dict(exemplar)))
+
+    def tail_exemplars(self, k=None, window_s=None, now=None):
+        """The K largest exemplar'd in-window samples, worst first, as
+        ``(value, labels)`` — "the actual p99 request of the last
+        minute", live (the :class:`Histogram` exemplars answer the same
+        question over the process lifetime).  Does NOT emit
+        ``exemplar_recorded`` (the paired histogram observation already
+        did; double events would double-join in ``report --tail``)."""
+        window_s = self.DEFAULT_WINDOW_S if window_s is None else window_s
+        now = time.perf_counter() if now is None else float(now)
+        if k is None:
+            k = _exemplar_limits()[0]
+        with self._lock:
+            live = [(v, labels) for t, v, labels in self._ex
+                    if now - t <= window_s]
+        return sorted(live, key=lambda e: -e[0])[:max(k, 0)]
 
     def values(self, window_s=None, now=None):
         """In-window sample values, oldest first."""
@@ -365,10 +451,25 @@ def _prom_name(name):
         c if c.isalnum() or c == "_" else "_" for c in name)
 
 
+def _escape_label(v):
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _exemplar_suffix(exemplar):
+    """OpenMetrics exemplar clause for one bucket line:
+    ``# {trace_id="..",span_id=".."} <value> <unix_ts>``."""
+    v, unix_t, labels = exemplar
+    body = ",".join(f'{k}="{_escape_label(val)}"'
+                    for k, val in sorted(labels.items()))
+    return f"# {{{body}}} {v:.6g} {unix_t:.3f}"
+
+
 def to_prometheus():
     """Render the registry in the Prometheus text exposition format
     (counters/gauges as single samples, histograms as the standard
-    ``_bucket``/``_sum``/``_count`` family)."""
+    ``_bucket``/``_sum``/``_count`` family, with OpenMetrics exemplar
+    clauses on the buckets that hold one)."""
     with _REGISTRY_LOCK:
         items = sorted(_REGISTRY.items())
     lines = []
@@ -394,12 +495,19 @@ def to_prometheus():
             lines.append(f"# TYPE {pn} histogram")
             last_nonzero = 0
             pairs = m.buckets()
+            ex = m.exemplars()
             for i, (_, acc) in enumerate(pairs):
                 if acc != (pairs[i - 1][1] if i else 0):
                     last_nonzero = i
-            for bound, acc in pairs[: last_nonzero + 1]:
-                lines.append(f'{pn}_bucket{{le="{bound:.6g}"}} {acc}')
-            lines.append(f'{pn}_bucket{{le="+Inf"}} {m.count}')
+            for i, (bound, acc) in enumerate(pairs[: last_nonzero + 1]):
+                line = f'{pn}_bucket{{le="{bound:.6g}"}} {acc}'
+                if i in ex:
+                    line += f" {_exemplar_suffix(ex[i])}"
+                lines.append(line)
+            line = f'{pn}_bucket{{le="+Inf"}} {m.count}'
+            if len(BUCKET_BOUNDS) in ex:  # overflow-bucket exemplar
+                line += f" {_exemplar_suffix(ex[len(BUCKET_BOUNDS)])}"
+            lines.append(line)
             lines.append(f"{pn}_sum {m.sum}")
             lines.append(f"{pn}_count {m.count}")
     return "\n".join(lines) + "\n"

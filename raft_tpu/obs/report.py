@@ -837,6 +837,122 @@ def render_report(events, n_bad=0, source="<events>"):
     return "\n".join(out) + "\n"
 
 
+# ------------------------------------------------------------- tail view
+
+
+def tail_view(events, rank=0.95):
+    """"The actual p99 request": the single served request at latency
+    rank ``rank``, reconstructed from a capture (``obs report --tail``).
+
+    Ranks the ``serve_request_stages`` events by measured wall, picks
+    the one at ``rank``, joins the ``exemplar_recorded`` attrs stamped
+    by the batcher (design content hash, bucket signature, dispatched
+    rows, cache-hit bit, int32 status word, replica id) on ``span_id``,
+    and pulls the request's full span tree out of the capture by
+    ``trace_id`` — so the tail is a concrete request with an identity
+    and a timeline, not a percentile in a histogram.  Returns None when
+    the capture has no stage events."""
+    reqs = [e for e in events if e["event"] == "serve_request_stages"]
+    if not reqs:
+        return None
+    reqs.sort(key=lambda e: float(e.get("wall_s") or 0.0))
+    i = min(len(reqs) - 1, max(0, round(rank * (len(reqs) - 1))))
+    e = reqs[i]
+    stages = {s: float(e.get(f"{s}_s") or 0.0) for s in SERVE_STAGES}
+    exemplar = None
+    if e.get("span_id"):
+        for x in events:
+            if x["event"] == "exemplar_recorded" \
+                    and x.get("span_id") == e["span_id"]:
+                exemplar = {k: v for k, v in x.items()
+                            if k not in ("t", "event", "pid", "run_id",
+                                         "metric", "value")}
+                exemplar["metric"] = x.get("metric")
+                break
+    tree = []
+    if e.get("trace_id"):
+        spans, _unmatched = collect_spans(events)
+        trace_spans = [s for s in spans if s["trace_id"] == e["trace_id"]]
+        by_parent: dict = {}
+        for s in trace_spans:
+            by_parent.setdefault(s["parent_id"], []).append(s)
+        ids = {s["span_id"] for s in trace_spans}
+        roots = sorted((s for s in trace_spans
+                        if s["parent_id"] not in ids),
+                       key=lambda s: s["t0"])
+
+        def walk(s, depth):
+            tree.append({"name": s["name"], "depth": depth,
+                         "t0": s["t0"], "wall_s": s["wall_s"],
+                         "ok": s.get("ok", True),
+                         "span_id": s["span_id"],
+                         "attrs": s["attrs"]})
+            for c in sorted(by_parent.get(s["span_id"], []),
+                            key=lambda c: c["t0"]):
+                walk(c, depth + 1)
+
+        for r in roots:
+            walk(r, 0)
+    return {
+        "rank": rank,
+        "n_requests": len(reqs),
+        "wall_s": round(float(e.get("wall_s") or 0.0), 6),
+        "stages": {k: round(v, 6) for k, v in stages.items()},
+        "stages_sum_s": round(sum(stages.values()), 6),
+        "trace_id": e.get("trace_id"),
+        "span_id": e.get("span_id"),
+        "escalated": bool(e.get("escalated")),
+        "exemplar": exemplar,
+        "spans": tree,
+    }
+
+
+def render_tail(events, rank=0.95, source="<events>"):
+    """Text rendering of :func:`tail_view` (``obs report --tail``)."""
+    data = tail_view(events, rank)
+    out = [f"tail exemplar — {source}"]
+    if data is None:
+        out.append("  no serve_request_stages events in this capture "
+                   "(was a server handling dispatched requests?)")
+        return "\n".join(out) + "\n"
+    out.append(
+        f"  the p{int(round(rank * 100))} request of "
+        f"{data['n_requests']} dispatched: wall {data['wall_s']:.6f}s"
+        + ("  [escalated]" if data["escalated"] else ""))
+    ex = data["exemplar"]
+    if ex:
+        parts = [f"{k}={ex[k]}" for k in
+                 ("design", "sig", "rows", "cache_hit", "status",
+                  "replica") if ex.get(k) is not None]
+        out.append("  identity: " + (", ".join(parts) or "—")
+                   + (f"  (exemplar of {ex.get('metric')})"
+                      if ex.get("metric") else ""))
+    if data["trace_id"]:
+        out.append(f"  trace {data['trace_id']}  span {data['span_id']}")
+    out.append("")
+    out.append(f"  {'stage':24s} {'wall':>10s}")
+    for stage in SERVE_STAGES:
+        out.append(f"  {stage:24s} {_fmt_s(data['stages'].get(stage))}")
+    out.append(f"  {'sum of stages':24s} {_fmt_s(data['stages_sum_s'])}")
+    out.append(f"  {'total (measured)':24s} {_fmt_s(data['wall_s'])}")
+    if data["spans"]:
+        out.append("")
+        out.append("  span tree of this request's trace")
+        for s in data["spans"]:
+            label = "  " * s["depth"] + s["name"]
+            attrs = ", ".join(f"{k}={v}" for k, v in sorted(
+                s["attrs"].items()) if k not in ("remote_parent",
+                                                 "boundary"))
+            out.append(f"    {label:36s} {_fmt_s(s['wall_s'])}"
+                       + (f"  [{attrs}]" if attrs else "")
+                       + ("" if s["ok"] else "  FAILED"))
+    elif data["span_id"]:
+        out.append("")
+        out.append("  (no spans for this trace in the capture — span "
+                   "records need RAFT_TPU_LOG or a merged flight shard)")
+    return "\n".join(out) + "\n"
+
+
 # ----------------------------------------------------------- chrome trace
 
 

@@ -33,7 +33,7 @@ import re
 import time
 import uuid
 
-from raft_tpu.obs import metrics
+from raft_tpu.obs import flight, metrics
 from raft_tpu.utils import config, structlog
 
 
@@ -164,7 +164,11 @@ class span:
                 self._ann = None
         self._t0 = time.perf_counter()
         if not structlog.enabled():
-            return self  # fast path: no ids, no contextvar, no event
+            # fast path: no ids, no contextvar, no event — the flight
+            # ring still records the begin (ids are synthesized at
+            # dump time from the per-thread nesting order)
+            flight.capture_span_begin(self.name, self.attrs)
+            return self
         parent = structlog.SPAN_CTX.get()
         kw = {}
         if parent is None:
@@ -187,8 +191,16 @@ class span:
     def __exit__(self, exc_type, exc, tb):
         wall = time.perf_counter() - self._t0
         # the wall-time histogram feeds unconditionally (metrics exist
-        # without the event stream); events only when the sink is live
-        metrics.histogram(f"span_{self.name}_s").observe(wall)
+        # without the event stream); events only when the sink is live.
+        # With live ids the observation carries an exemplar, so a
+        # /metrics scrape can name the actual slowest span instance.
+        if self.span_id is not None:
+            metrics.histogram(f"span_{self.name}_s").observe(
+                wall, exemplar={"trace_id": self.trace_id,
+                                "span_id": self.span_id})
+        else:
+            metrics.histogram(f"span_{self.name}_s").observe(wall)
+            flight.capture_span_end(self.name, wall, exc_type is None)
         if self._token is not None:
             kw = {}
             if exc_type is not None:

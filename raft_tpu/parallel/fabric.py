@@ -636,6 +636,12 @@ class Worker:
             programs_compiled=counters0.get("aot_programs_compiled", 0))
         if warmup_s is not None:
             start_kw["warmup_s"] = round(warmup_s, 2)
+        # arm the flight recorder (no-op without RAFT_TPU_FLIGHT_DIR):
+        # a preempted/OOM-killed worker leaves a black box with its
+        # last shards' spans even when RAFT_TPU_LOG was never set
+        from raft_tpu.obs import flight
+
+        flight.maybe_start()
         log_event("fabric_worker_start", **start_kw)
         progress = {"out_dir": self.out_dir, "shards_done": 0,
                     "n_shards": self.n_shards}

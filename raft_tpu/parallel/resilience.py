@@ -737,7 +737,23 @@ def evaluate_shard(compute, chunk, shard, offset, mesh, max_retries=3,
         metrics.counter("rows_quarantined").inc(
             sum(1 for e in entries if not e.get("resolved")))
         metrics.counter("rows_flagged").inc(len(flagged_rows(out)))
-        metrics.histogram("shard_wall_s").observe(wall)
+        # latency exemplar: enough identity to name the p99 SHARD from
+        # a /metrics scrape or a flight dump — which shard, how many
+        # rows, how many rows stayed quarantined, on which worker —
+        # and the span ids to pull its retry/escalation subtree out of
+        # a merged trace
+        from raft_tpu.obs import spans as spans_mod
+
+        ex = {"shard": int(shard), "rows": int(rows),
+              "quarantined": sum(1 for e in entries
+                                 if not e.get("resolved"))}
+        wid = config.raw("WORKER_ID")
+        if wid:
+            ex["worker"] = wid
+        ids = spans_mod.current_ids()
+        if ids is not None:
+            ex["trace_id"], ex["span_id"] = ids
+        metrics.histogram("shard_wall_s").observe(wall, exemplar=ex)
         log_event("shard_done", shard=shard, rows=rows,
                   wall_s=round(wall, 3))
     return out, entries, wall
@@ -922,6 +938,7 @@ def _quarantine_shard(compute, chunk, out, bad, flagged, shard, offset, mesh,
     rungs = escalation_rungs()
     cpu_mesh = _cpu_mesh(mesh) if retry_solo else None
     bad_set = {int(b) for b in bad}
+    severe_unresolved = 0
     for i in sorted(bad_set | {int(f) for f in flagged}):
         nonfinite = i in bad_set
         keys_bad = [k for k, v in out.items()
@@ -966,6 +983,9 @@ def _quarantine_shard(compute, chunk, out, bad, flagged, shard, offset, mesh,
                   keys=keys_bad, recovered=recovered,
                   status=int(status_before),
                   reason=health.describe(status_before))
+        if not recovered and (status_after & health.SEVERE
+                              or nonfinite):
+            severe_unresolved += 1
         # escalated rows are recorded even when resolved (the ladder's
         # outcome is part of the audit trail); the legacy NaN-only path
         # records only rows that stayed bad
@@ -985,6 +1005,14 @@ def _quarantine_shard(compute, chunk, out, bad, flagged, shard, offset, mesh,
             if escalation is not None:
                 entry["escalation"] = escalation
             entries.append(entry)
+    if severe_unresolved:
+        # a SEVERE row the ladder could not clear is a postmortem
+        # moment: persist the flight ring (one dump per shard, after
+        # the loop — not one per row) with the recent solve/dispatch
+        # history that led to it
+        from raft_tpu.obs import flight
+
+        flight.dump(trigger="quarantine-severe")
     return out, entries
 
 

@@ -158,8 +158,13 @@ def _serve_main(argv):
                   root=release_mod.releases_dir())
         print(f"release: {cur_release}", flush=True)
 
+    # the replica id is fixed BEFORE anything starts: the provenance
+    # stamp, the fleet lease, and the latency exemplars on /metrics
+    # must all name the same replica
+    rid = args.replica_id or f"replica-{uuid.uuid4().hex[:8]}"
+
     out_keys = tuple(k.strip() for k in args.out_keys.split(",") if k.strip())
-    batcher = Batcher(registry, out_keys=out_keys)
+    batcher = Batcher(registry, out_keys=out_keys, replica_id=rid)
     if not args.no_warm:
         try:
             reports = engine.warm(
@@ -198,9 +203,6 @@ def _serve_main(argv):
                   f"{list(refined)} (cost-flat rungs pruned)", flush=True)
             batcher.set_sizes(refined)
 
-    # the replica id is fixed BEFORE the server starts: the provenance
-    # stamp and the fleet lease must name the same replica
-    rid = args.replica_id or f"replica-{uuid.uuid4().hex[:8]}"
     # provenance stamps (x-raft-provenance on every /evaluate
     # response): bank key + sidecar sha per design, code hash, flags
     # key, replica id — computed once here, a dict lookup per request

@@ -49,6 +49,7 @@ redundant under the 200-client load).  Backpressure: per-client token buckets ra
 from __future__ import annotations
 
 import concurrent.futures
+import os
 import threading
 import time
 from collections import deque
@@ -59,6 +60,7 @@ from raft_tpu.obs import metrics
 from raft_tpu.obs.spans import span
 from raft_tpu.serve import engine
 from raft_tpu.serve.cache import ResultCache, result_cache_key
+from raft_tpu.structure import bucketing
 from raft_tpu.serve.quota import ClientQuotas
 from raft_tpu.utils import config, health, structlog
 from raft_tpu.utils.structlog import log_event
@@ -114,9 +116,10 @@ class _Request:
         # tick span links to it, so one trace covers client -> queue ->
         # tick -> dispatch -> response across the thread boundary
         self.trace_ctx = trace_ctx
-        # (tick_t0, dispatch_t0, dispatch_t1, solve_s) stamped by the
-        # tick that dispatched this request — the tail-attribution
-        # stage decomposition reads these at resolve time
+        # (tick_t0, dispatch_t0, dispatch_t1, solve_s, rows) stamped by
+        # the tick that dispatched this request — the tail-attribution
+        # stage decomposition and the latency exemplar read these at
+        # resolve time (rows = unique dispatched rows in the group)
         self.t_marks = None
 
 
@@ -131,10 +134,15 @@ class Batcher:
     """
 
     def __init__(self, registry, out_keys=None, mesh=None, tick_ms=None,
-                 max_batch=None, cache=None, quotas=None, queue_bound=None):
+                 max_batch=None, cache=None, quotas=None, queue_bound=None,
+                 replica_id=None):
         from raft_tpu.parallel.sweep import make_mesh
 
         self.registry = registry
+        # stamped into latency exemplars so a /metrics scrape of a
+        # fleet names WHICH replica served the p99 request
+        self.replica_id = str(replica_id) if replica_id else (
+            f"pid-{os.getpid()}")
         # status is non-optional: per-request error semantics read it
         self.out_keys = engine.normalize_out_keys(out_keys)
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -380,7 +388,7 @@ class Batcher:
                     continue
                 t_d1 = time.perf_counter()
                 solve_s = min(timings.get("solve_s") or 0.0, t_d1 - t_d0)
-                marks = (tick_t0, t_d0, t_d1, solve_s)
+                marks = (tick_t0, t_d0, t_d1, solve_s, len(firsts))
                 for i, rl in enumerate(chunk):
                     row = {k: out[k][i] for k in self.out_keys}
                     # retire the in-flight key before fan-out: joiners
@@ -443,7 +451,24 @@ class Batcher:
         if not req.future.set_running_or_notify_cancel():
             return  # requester went away (client timeout/cancel)
         wall = time.perf_counter() - req.t_submit
-        metrics.histogram("serve_request_s").observe(wall)
+        # the exemplar carried by this observation if it lands in a
+        # histogram's top-K: enough identity to reconstruct WHAT the
+        # p99 request actually was (which design, which compiled
+        # bucket, how many rows rode its dispatch, did the cache
+        # answer, how did the solver judge it, on which replica) and
+        # to join it back to its span tree via trace_id/span_id
+        exemplar = {
+            "design": req.entry.fingerprint,
+            "sig": bucketing.signature_fingerprint(req.entry.sig),
+            "cache_hit": int(bool(cache_hit)),
+            "status": status,
+            "replica": self.replica_id,
+        }
+        if req.trace_ctx is not None:
+            exemplar["trace_id"], exemplar["span_id"] = req.trace_ctx
+        if req.t_marks is not None:
+            exemplar["rows"] = int(req.t_marks[4])
+        metrics.histogram("serve_request_s").observe(wall, exemplar=exemplar)
         if req.t_marks is not None and not cache_hit \
                 and req.t_submit <= req.t_marks[0]:
             # tail attribution: split this request's end-to-end latency
@@ -455,7 +480,7 @@ class Batcher:
             # A cross-tick JOINER (submitted after its row's tick began)
             # is excluded: the tick-level stage windows started before
             # it existed, so they cannot decompose ITS wall
-            tick_t0, d0, d1, solve_s = req.t_marks
+            tick_t0, d0, d1, solve_s = req.t_marks[:4]
             stages = {
                 "queue_wait": max(tick_t0 - req.t_submit, 0.0),
                 "tick_wait": max(d0 - tick_t0, 0.0),
@@ -466,13 +491,20 @@ class Batcher:
             for name, v in stages.items():
                 metrics.histogram(f"serve_stage_{name}_s").observe(v)
             if structlog.enabled():
+                # stamp the REQUEST's ids explicitly (payload kwargs
+                # override the ambient tick-span context), so `obs
+                # report --tail` can join an exemplar's span_id
+                # straight to this stage breakdown
+                ids = req.trace_ctx or (None, None)
                 log_event("serve_request_stages", wall_s=round(wall, 6),
                           escalated=escalated is not None,
+                          trace_id=ids[0], span_id=ids[1],
                           **{f"{k}_s": round(v, 6)
                              for k, v in stages.items()})
         # the sliding-window twin of the lifetime histogram: /healthz
         # p50/p95-over-last-N-seconds and the SLO breach gate read this
-        metrics.window("serve_request_window_s").observe(wall)
+        metrics.window("serve_request_window_s").observe(wall,
+                                                         exemplar=exemplar)
         slo_ms = float(config.get("SERVE_SLO_MS") or 0)
         if slo_ms > 0 and wall * 1e3 > slo_ms:
             metrics.counter("serve_slo_breaches").inc()

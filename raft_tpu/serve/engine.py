@@ -33,7 +33,7 @@ import time
 import numpy as np
 
 from raft_tpu.obs import metrics
-from raft_tpu.obs.spans import span
+from raft_tpu.obs.spans import current_ids, span
 from raft_tpu.structure import bucketing
 from raft_tpu.utils import config
 from raft_tpu.utils.structlog import log_event
@@ -369,8 +369,16 @@ def dispatch(entries, Hs, Tp, beta, out_keys=DEFAULT_OUT_KEYS, mesh=None,
     if record_metrics:
         metrics.counter("serve_dispatches").inc()
         metrics.counter("serve_rows_dispatched").inc(n)
-        metrics.histogram("serve_batch_rows").observe(n)
-        metrics.histogram("serve_batch_occupancy").observe(n / padded)
+        # batch-shape exemplar: WHICH compiled bucket produced the
+        # biggest (or emptiest) dispatch, joinable to its span tree
+        ex = {"sig": bucketing.signature_fingerprint(sig),
+              "rows": int(n), "padded": int(padded)}
+        ids = current_ids()
+        if ids is not None:
+            ex["trace_id"], ex["span_id"] = ids
+        metrics.histogram("serve_batch_rows").observe(n, exemplar=ex)
+        metrics.histogram("serve_batch_occupancy").observe(n / padded,
+                                                           exemplar=ex)
         # waste attribution: the same per-axis pad accounting the
         # bucketed sweeps feed, here weighted by served request rows
         bucketing.observe_axis_waste([e.axes for e in entries],

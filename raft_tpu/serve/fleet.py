@@ -351,6 +351,13 @@ def spawn_replica(root, designs_spec, index=0, replica_id=None,
         os.path.abspath(__file__))))
     old_pp = wenv.get("PYTHONPATH", "")
     wenv["PYTHONPATH"] = repo + (os.pathsep + old_pp if old_pp else "")
+    # every fleet replica leaves a black box: unless the operator
+    # pointed the flight recorder elsewhere, its dumps land next to
+    # the replica logs — a SIGKILLed replica's last seconds are then
+    # one `obs trace --merge` away from its survivors' story
+    wenv.setdefault(
+        config.env_name("FLIGHT_DIR"),
+        os.path.abspath(os.path.join(_replicas_dir(root), "flight")))
     fsops.makedirs(_replicas_dir(root), exist_ok=True)
     logf = open(os.path.join(_replicas_dir(root), f"{rid}.log"), "ab")
     argv = [sys.executable, "-m", "raft_tpu.serve"]

@@ -331,6 +331,18 @@ class Server:
             return 200, payload
         if path == "/metrics":
             return 200, metrics.to_prometheus()  # text, not JSON
+        if path == "/debug/flight":
+            # the live flight ring as a JSONL shard — postmortem-grade
+            # history (spans/events/metric deltas with logging off)
+            # without restarting anything.  Loopback-gated like /drain:
+            # ring payloads carry design hashes and client ids, which a
+            # tenant must not be able to read
+            if peer_host not in wire.LOOPBACK_HOSTS:
+                return 403, {"ok": False,
+                             "error": "/debug/flight is loopback-only"}
+            from raft_tpu.obs import flight
+
+            return 200, flight.serialize_text(trigger="debug")  # text
         if path == "/designs":
             return 200, {"ok": True, "designs": self.batcher.registry.names()}
         return 404, {"ok": False, "error": f"no route {path}"}
@@ -413,6 +425,12 @@ class Server:
             except (NotImplementedError, RuntimeError):
                 pass
         self.batcher.start()
+        # arm the flight recorder's periodic flush + crash hooks (no-op
+        # without RAFT_TPU_FLIGHT_DIR): a SIGKILLed replica must leave
+        # its last seconds behind for the kill-a-replica postmortem
+        from raft_tpu.obs import flight
+
+        flight.maybe_start()
         log_event("serve_start", host=self.host, port=self.port,
                   designs=self.batcher.registry.names(),
                   tick_ms=self.batcher.tick_s * 1e3,

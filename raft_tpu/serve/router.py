@@ -724,8 +724,18 @@ class Router:
                 await self.failover(key, send)
         wall = time.perf_counter() - t0
         metrics.counter("router_requests").inc()
-        metrics.histogram("router_request_s").observe(wall)
-        metrics.window("router_request_window_s").observe(wall)
+        # latency exemplar: who answered the p99 route and which trace
+        # holds its span tree (attempt/hedge counts tell the failover
+        # story without opening the trace)
+        exemplar = {"replica": str(rid), "code": int(status),
+                    "attempts": int(attempts), "hedged": int(bool(hedged))}
+        if req_span.span_id is not None:
+            exemplar["trace_id"] = req_span.trace_id
+            exemplar["span_id"] = req_span.span_id
+        metrics.histogram("router_request_s").observe(wall,
+                                                      exemplar=exemplar)
+        metrics.window("router_request_window_s").observe(wall,
+                                                          exemplar=exemplar)
         prov = (hdrs.get("x-raft-provenance")
                 if isinstance(hdrs, dict) else None)
         log_event("router_request", replica=rid, code=int(status),
@@ -769,7 +779,8 @@ class Router:
                      "window": win,
                      **snap, **counters}
 
-    async def _route(self, method, path, body, headers, client):
+    async def _route(self, method, path, body, headers, client,
+                     peer_host="?"):
         if path == "/evaluate":
             if method != "POST":
                 return 405, {"ok": False, "error": "POST required"}, {}
@@ -796,6 +807,15 @@ class Router:
                          "designs": sorted(snap["designs"])}, {}
         if path == "/metrics":
             return 200, metrics.to_prometheus(), {}
+        if path == "/debug/flight":
+            # the router's black box, loopback-only like the replica's:
+            # serialize the live ring without touching disk
+            if peer_host not in wire.LOOPBACK_HOSTS:
+                return 403, {"ok": False,
+                             "error": "/debug/flight is loopback-only"}, {}
+            from raft_tpu.obs import flight
+
+            return 200, flight.serialize_text(trigger="debug"), {}
         return 404, {"ok": False, "error": f"no route {path}"}, {}
 
     # -------------------------------------------------------- connection
@@ -822,7 +842,8 @@ class Router:
                 try:
                     try:
                         status, payload, extra = await self._route(
-                            method, path, body, headers, client)
+                            method, path, body, headers, client,
+                            peer_host=peer_host)
                     except Exception as e:  # noqa: BLE001 — keep routing
                         status, payload, extra = 500, {
                             "ok": False, "error": repr(e)[:300]}, {}
@@ -850,6 +871,11 @@ class Router:
 
     async def start(self):
         loop = asyncio.get_running_loop()
+        # arm the flight recorder's flusher/crash hooks (no-op without
+        # RAFT_TPU_FLIGHT_DIR): a SIGKILLed router leaves a black box
+        from raft_tpu.obs import flight
+
+        flight.maybe_start()
         # populate the ring BEFORE binding: the first client request
         # must never race an empty membership (ledger IO — executor)
         await loop.run_in_executor(None, self.prober.probe_once)

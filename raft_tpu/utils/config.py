@@ -278,6 +278,39 @@ _register(
          help="when set, the metrics registry is exported in Prometheus "
               "text format to this path at sweep_done (scrape target "
               "for long runs)"),
+    # -- black-box flight recorder (see raft_tpu.obs.flight and README
+    #    "Flight recorder & exemplars")
+    Flag("FLIGHT_RING", "int", 4096,
+         help="flight-recorder ring capacity in records (spans, events, "
+              "metric deltas; ~200B each in memory).  Always on — every "
+              "process keeps its last N records for postmortem dumps "
+              "even with RAFT_TPU_LOG unset; 0 disables the recorder"),
+    Flag("FLIGHT_DIR", "str", "",
+         help="flight-dump shard directory: when set, the ring is "
+              "flushed atomically to <dir>/flight-<pid>.jsonl every "
+              "RAFT_TPU_FLIGHT_FLUSH_S (what a SIGKILLed process "
+              "leaves behind) and trigger dumps (alert_fire, SEVERE "
+              "quarantine, compile-budget breach, crash/SIGTERM) land "
+              "as trigger-named siblings.  Unset: ring only (still "
+              "dumpable via GET /debug/flight and `obs flight dump -o`)"),
+    Flag("FLIGHT_FLUSH_S", "float", 2.0,
+         help="period of the background flight-ring flush to "
+              "RAFT_TPU_FLIGHT_DIR — the upper bound on history lost "
+              "to an uncatchable SIGKILL"),
+    Flag("FLIGHT_SNAP_S", "float", 10.0,
+         help="period of the flight ring's metric-snapshot delta "
+              "records (counter movement between snapshots — rate "
+              "context for a postmortem)"),
+    Flag("EXEMPLAR_K", "int", 2,
+         help="exemplars kept per histogram log-bucket (top-K by "
+              "value): the trace/span ids + caller attrs of the actual "
+              "slowest requests, exported in OpenMetrics exemplar "
+              "syntax on /metrics and joined by `obs report --tail`. "
+              "0 disables exemplar capture"),
+    Flag("EXEMPLAR_MIN_S", "float", 0.0,
+         help="minimum observed value for exemplar admission (mute "
+              "exemplar bookkeeping + exemplar_recorded events for "
+              "uninteresting fast observations)"),
     # -- longitudinal run-record store (see raft_tpu.obs.runs and
     #    README "Performance regression tracking")
     Flag("RUNS_DIR", "str", "",

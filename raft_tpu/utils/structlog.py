@@ -56,6 +56,20 @@ _RUN_ID = None  # raft-lint: guarded-by=_LOCK
 SPAN_CTX: contextvars.ContextVar = contextvars.ContextVar(
     "raft_tpu_span_ctx", default=None)
 
+#: Flight-recorder tap (:mod:`raft_tpu.obs.flight` installs itself here
+#: at import).  Called as ``tap(event, payload)`` for EVERY log_event —
+#: before the sink check, so the black-box ring keeps recording when
+#: logging is off.  structlog deliberately does not import flight (the
+#: dependency points the other way); the slot keeps this module
+#: importable standalone.
+_FLIGHT_TAP = None
+
+
+def set_flight_tap(fn):
+    """Install (or clear, with None) the flight-recorder tap."""
+    global _FLIGHT_TAP
+    _FLIGHT_TAP = fn
+
 
 def run_id():
     """The telemetry run id stamped on every record: ``RAFT_TPU_RUN_ID``
@@ -140,7 +154,11 @@ def _anchor_record():
 
 
 def log_event(event, **payload):
-    """Emit one JSONL event (no-op unless RAFT_TPU_LOG is set)."""
+    """Emit one JSONL event (no-op unless RAFT_TPU_LOG is set; the
+    flight-recorder ring captures it either way)."""
+    tap = _FLIGHT_TAP
+    if tap is not None:
+        tap(event, payload)
     s = _sink()
     if s is None:
         return

@@ -120,19 +120,42 @@ def test_span_failure_records_error_and_reraises(log_path):
 
 
 def test_zero_overhead_fast_path_when_log_unset(monkeypatch):
+    from raft_tpu.analysis import recompile
+    from raft_tpu.obs import flight
+
     monkeypatch.delenv("RAFT_TPU_LOG", raising=False)
     monkeypatch.delenv("RAFT_TPU_PROFILE", raising=False)
+    monkeypatch.delenv("RAFT_TPU_FLIGHT_RING", raising=False)
+    monkeypatch.delenv("RAFT_TPU_FLIGHT_DIR", raising=False)
     # the propagation path must ride the same fast path: an inherited
     # traceparent is only parsed/adopted when the sink is live
     monkeypatch.setenv("RAFT_TPU_TRACEPARENT",
                        "00-" + "a" * 32 + "-" + "b" * 16 + "-01")
-    with span("quiet", x=1) as s:
-        # no ids generated, no contextvar touched, nothing emitted
-        assert s.span_id is None and current_ids() is None
-    assert not structlog.enabled()
-    # the wall-time histogram still feeds (metrics are independent of
-    # the event stream) — but no event was produced anywhere
-    assert metrics.histogram("span_quiet_s").count == 1
+    flight.reset()
+    try:
+        with span("quiet", x=1) as s:
+            # no ids generated, no contextvar touched, nothing emitted
+            assert s.span_id is None and current_ids() is None
+        assert not structlog.enabled()
+        # the wall-time histogram still feeds (metrics are independent
+        # of the event stream) — but no event was produced anywhere
+        assert metrics.histogram("span_quiet_s").count == 1
+        # the always-on flight ring (default size) captured the pair
+        # without turning the span path on
+        assert [r[0] for r in flight.ring_records()] == ["sb", "se"]
+        # and the recorder keeps the fast path µs-cheap and compile-
+        # free: a span begin/end pair is two deque appends, no jax
+        n = 2000
+        with recompile.count_compilations() as clog:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with span("quiet"):
+                    pass
+            per_span = (time.perf_counter() - t0) / n
+        assert clog.count == 0
+        assert per_span < 100e-6, f"span pair cost {per_span * 1e6:.1f}µs"
+    finally:
+        flight.reset()
 
 
 # ----------------------------------------------- cross-process propagation
@@ -805,3 +828,306 @@ def test_run_id_defaults_to_process_uuid(log_path, monkeypatch):
     assert rid and rid == structlog.run_id()  # stable within the process
     monkeypatch.setenv("RAFT_TPU_RUN_ID", "pinned42")
     assert structlog.run_id() == "pinned42"
+
+# -------------------------------------------------------- flight recorder
+
+
+@pytest.fixture
+def flight_ring(monkeypatch):
+    """A fresh default-size flight ring with no dump directory (no
+    flusher thread, no crash hooks) — reset again on exit so the ring
+    never leaks captures across tests."""
+    from raft_tpu.obs import flight
+
+    monkeypatch.delenv("RAFT_TPU_FLIGHT_RING", raising=False)
+    monkeypatch.delenv("RAFT_TPU_FLIGHT_DIR", raising=False)
+    flight.reset()
+    yield flight
+    flight.reset()
+
+
+def test_flight_ring_captures_with_logging_off(flight_ring, monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_LOG", raising=False)
+    flight = flight_ring
+    with span("boxed", shard=3):
+        structlog.log_event("shard_start", shard=3, rows=8)
+    assert not structlog.enabled()          # no sink anywhere...
+    # ...yet the ring holds the span pair and the event payload
+    recs = flight.ring_records()
+    assert [r[0] for r in recs] == ["sb", "ev", "se"]
+    assert recs[1][2] == "shard_start" and recs[1][4]["rows"] == 8
+    # the ring is bounded: RAFT_TPU_FLIGHT_RING caps memory, oldest out
+    monkeypatch.setenv("RAFT_TPU_FLIGHT_RING", "4")
+    flight.reset()
+    for i in range(10):
+        structlog.log_event("shard_start", shard=i, rows=1)
+    recs = flight.ring_records()
+    assert len(recs) == 4 and recs[-1][4]["shard"] == 9
+    # ...and 0 disables capture entirely
+    monkeypatch.setenv("RAFT_TPU_FLIGHT_RING", "0")
+    flight.reset()
+    structlog.log_event("shard_start", shard=0, rows=1)
+    assert flight.ring_records() == []
+
+
+def test_flight_dump_synthesizes_deterministic_span_ids(flight_ring,
+                                                        monkeypatch):
+    """Fast-path span records carry no ids; the dump synthesizes them
+    from the per-thread nesting order, deterministically — so repeated
+    dumps of one ring agree and merge without orphans."""
+    from raft_tpu.obs.report import chrome_trace, collect_spans
+
+    monkeypatch.delenv("RAFT_TPU_LOG", raising=False)
+    flight = flight_ring
+    with span("outer", job=1):
+        with span("inner"):
+            structlog.log_event("shard_start", shard=0, rows=4)
+        with span("inner"):
+            pass
+    recs = flight.serialize_records(trigger="manual")
+    hdr = recs[0]
+    assert hdr["event"] == "proc_start" and hdr["unix_t"] > 1e9
+    assert hdr["flight"]["version"] == flight.SCHEMA_VERSION
+    assert hdr["flight"]["trigger"] == "manual"
+    assert hdr["flight"]["records"] == len(recs) - 1
+    begins = [r for r in recs if r["event"] == "span_begin"]
+    outer = next(r for r in begins if r["name"] == "outer")
+    inners = [r for r in begins if r["name"] == "inner"]
+    assert outer["parent_id"] is None and outer["job"] == 1
+    assert len({r["span_id"] for r in begins}) == 3
+    assert all(r["parent_id"] == outer["span_id"] for r in inners)
+    assert all(r["trace_id"] == outer["trace_id"] for r in begins)
+    spans_, unmatched = collect_spans(recs)
+    assert len(spans_) == 3 and not unmatched
+    assert chrome_trace(recs)["otherData"]["spans_orphaned"] == 0
+    # a second dump of the SAME ring mints identical ids (overlapping
+    # shards collapse in collect_spans instead of double-counting)
+    recs2 = flight.serialize_records(trigger="again")
+
+    def ids(rs):
+        return [(r.get("span_id"), r.get("parent_id")) for r in rs[1:]]
+
+    assert ids(recs2) == ids(recs)
+
+
+def test_flight_dump_roundtrip_and_layout(flight_ring, tmp_path,
+                                          monkeypatch, capsys):
+    flight = flight_ring
+    monkeypatch.delenv("RAFT_TPU_LOG", raising=False)
+    with span("boxed"):
+        pass
+    # no RAFT_TPU_FLIGHT_DIR and no explicit path: nowhere to write
+    assert flight.dump(trigger="manual") is None
+    p = str(tmp_path / "flight.jsonl")
+    assert flight.dump(trigger="manual", path=p) == p
+    hdr, records = flight.read_shard(p)
+    assert hdr["flight"]["trigger"] == "manual"
+    assert [r["event"] for r in records[1:]] == ["span_begin", "span_end"]
+    assert flight.show(p) == 0
+    out = capsys.readouterr().out
+    assert "flight shard v1" in out and "span_begin" in out
+    # trigger-slugged filenames under the dump dir: an alert dump never
+    # clobbers the stable per-process flush shard
+    monkeypatch.setenv("RAFT_TPU_FLIGHT_DIR", str(tmp_path / "box"))
+    auto = flight.dump(trigger="alert-p99 High!")
+    assert auto.endswith(f"flight-{os.getpid()}-alert-p99-high.jsonl")
+    assert flight.dump_path("flush").endswith(
+        f"flight-{os.getpid()}.jsonl")
+
+
+def test_flight_reader_rejects_damaged_shards(flight_ring, tmp_path,
+                                              capsys):
+    """Unlike the tolerant live-capture reader, a flight shard is
+    written atomically — ANY damage means the artifact is not
+    trustworthy, and show exits 1 (the lint.sh gate)."""
+    flight = flight_ring
+    structlog.log_event("shard_start", shard=0, rows=1)
+    ok = tmp_path / "ok.jsonl"
+    flight.dump(path=str(ok), quiet=True)
+    text = ok.read_text()
+    # torn tail (what a non-atomic writer would leave): refused
+    trunc = tmp_path / "trunc.jsonl"
+    trunc.write_text(text[:-15])
+    with pytest.raises(flight.FlightError, match="unparseable"):
+        flight.read_shard(str(trunc))
+    assert flight.show(str(trunc)) == 1
+    assert "FAILED" in capsys.readouterr().err
+    # body without the proc_start anchor: unmergeable, refused
+    headless = tmp_path / "headless.jsonl"
+    headless.write_text("".join(text.splitlines(True)[1:]))
+    with pytest.raises(flight.FlightError, match="anchor"):
+        flight.read_shard(str(headless))
+    # a shard from a NEWER writer is refused, not guessed at
+    lines = text.splitlines(True)
+    hdr = json.loads(lines[0])
+    hdr["flight"]["version"] = flight.SCHEMA_VERSION + 1
+    newer = tmp_path / "newer.jsonl"
+    newer.write_text(json.dumps(hdr) + "\n" + "".join(lines[1:]))
+    with pytest.raises(flight.FlightError, match="newer"):
+        flight.read_shard(str(newer))
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    with pytest.raises(flight.FlightError, match="empty"):
+        flight.read_shard(str(empty))
+
+
+def test_flight_metrics_snapshot_rides_the_ring(flight_ring):
+    """Periodic counter DELTAS in the ring: a postmortem shows rates
+    (what moved in the last interval), not just lifetime totals."""
+    flight = flight_ring
+    metrics.counter("t_flight_rows").inc(5)
+    # the first capture after configure takes the initial snapshot
+    structlog.log_event("shard_start", shard=0, rows=5)
+    recs = flight.serialize_records()
+    mx = [r for r in recs if r["event"] == "flight_metrics"]
+    assert mx and mx[0]["counters"]["t_flight_rows"] == 5
+    # the next snapshot carries only the movement since the last one
+    metrics.counter("t_flight_rows").inc(2)
+    flight._snap_metrics(time.perf_counter() + 1000.0)
+    mx = [r for r in flight.serialize_records()
+          if r["event"] == "flight_metrics"]
+    assert mx[-1]["counters"]["t_flight_rows"] == 2
+
+
+def test_flight_flush_shard_survives_sigkill(tmp_path):
+    """The postmortem drill: a SIGKILLed process (no atexit, no
+    excepthook, nothing) leaves its periodic flush shard behind, and
+    the shard is schema-valid and merges with zero orphan spans."""
+    from raft_tpu.obs import flight
+
+    box = tmp_path / "box"
+    code = (
+        "import time\n"
+        "from raft_tpu.obs import flight, span\n"
+        "from raft_tpu.utils import structlog\n"
+        "assert flight.maybe_start()\n"
+        "i = 0\n"
+        "while True:\n"
+        "    with span('burst', i=i):\n"
+        "        with span('step'):\n"
+        "            structlog.log_event('shard_start', shard=i, rows=1)\n"
+        "    i += 1\n"
+        "    time.sleep(0.001)\n")
+    env = dict(os.environ,
+               PYTHONPATH=REPO,
+               RAFT_TPU_FLIGHT_DIR=str(box),
+               RAFT_TPU_FLIGHT_FLUSH_S="0.2")
+    env.pop("RAFT_TPU_LOG", None)   # logging OFF: only the black box
+    proc = subprocess.Popen([sys.executable, "-c", code], cwd=REPO,
+                            env=env)
+    shard = box / f"flight-{proc.pid}.jsonl"
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not shard.exists():
+            assert proc.poll() is None, "burst process died early"
+            time.sleep(0.02)
+        assert shard.exists(), "flusher never wrote the stable shard"
+        time.sleep(0.3)             # let one more flush land mid-burst
+    finally:
+        proc.kill()                 # SIGKILL — uncatchable by design
+        proc.wait(timeout=30)
+    hdr, records = flight.read_shard(str(shard))
+    assert hdr["flight"]["trigger"] == "flush"
+    names = {r["event"] for r in records}
+    assert "span_begin" in names and "shard_start" in names
+    # the dead replica's last seconds assemble onto the shared timeline
+    evs, bad, info = obs_report.merge_captures([str(box)])
+    assert bad == 0 and info["files"] == 1 and not info["unanchored_files"]
+    meta = obs_report.chrome_trace(evs, merged=True)["otherData"]
+    assert meta["spans_matched"] > 0
+    assert meta["spans_orphaned"] == 0
+
+
+# --------------------------------------------------------- tail exemplars
+
+
+def test_histogram_exemplar_topk_admission_and_threshold(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_EXEMPLAR_K", "2")
+    monkeypatch.delenv("RAFT_TPU_EXEMPLAR_MIN_S", raising=False)
+    h = metrics.histogram("t_ex")
+    h.observe(1.10, exemplar={"design": "a"})
+    h.observe(1.30, exemplar={"design": "b"})
+    h.observe(1.20, exemplar={"design": "c"})   # evicts the 1.10 slot
+    h.observe(1.05)                             # no exemplar: count only
+    ex = h.exemplars()
+    assert len(ex) == 1           # one occupied quarter-decade bucket
+    ((v, unix_t, labels),) = ex.values()
+    assert v == 1.30 and labels == {"design": "b"} and unix_t > 1e9
+    assert h.count == 4
+    # values below RAFT_TPU_EXEMPLAR_MIN_S never claim a slot
+    monkeypatch.setenv("RAFT_TPU_EXEMPLAR_MIN_S", "2.0")
+    h2 = metrics.histogram("t_ex_min")
+    h2.observe(1.5, exemplar={"design": "d"})
+    assert h2.exemplars() == {}
+    h2.observe(2.5, exemplar={"design": "e"})
+    assert [e[2] for e in h2.exemplars().values()] == [{"design": "e"}]
+
+
+def test_exemplar_renders_openmetrics_and_emits_event(log_path):
+    h = metrics.histogram("t_ex_prom")
+    h.observe(0.5, exemplar={"trace_id": "feed" * 4,
+                             "design": 'sp"ar\\1'})
+    text = metrics.to_prometheus()
+    (line,) = [l for l in text.splitlines()
+               if l.startswith("raft_tpu_t_ex_prom_bucket") and "# {" in l]
+    # OpenMetrics clause: # {labels} value unix_ts — labels escaped
+    assert 'trace_id="feedfeedfeedfeed"' in line
+    assert 'design="sp\\"ar\\\\1"' in line
+    tail = line.split("} ")[-1].split()
+    assert float(tail[0]) == 0.5 and float(tail[1]) > 1e9
+    # each ADMITTED exemplar logs the report --tail join key
+    (ev,) = _events(log_path, "exemplar_recorded")
+    assert ev["metric"] == "t_ex_prom" and ev["value"] == 0.5
+    assert ev["design"] == 'sp"ar\\1'
+    # a non-admitted observation (loses its bucket's top-K contest)
+    # stays silent — no event spam from the fast majority of requests
+    for _ in range(2):
+        h.observe(0.0001, exemplar={"design": "tiny"})  # fill the slots
+    n = len(_events(log_path, "exemplar_recorded"))
+    for _ in range(3):
+        h.observe(0.0001, exemplar={"design": "tied"})  # never beats
+    assert len(_events(log_path, "exemplar_recorded")) == n
+
+
+def test_window_tail_exemplars_rank_worst_first():
+    w = metrics.window("t_ex_win")
+    now = time.perf_counter()
+    w.observe(0.1, t=now - 1.0, exemplar={"design": "a"})
+    w.observe(0.9, t=now - 1.0, exemplar={"design": "b"})
+    w.observe(0.5, t=now - 1.0, exemplar={"design": "c"})
+    w.observe(5.0, t=now - 120.0, exemplar={"design": "old"})  # aged out
+    w.observe(2.0, t=now - 1.0)                 # no exemplar attached
+    tail = w.tail_exemplars(k=2, now=now)
+    assert [(v, lab["design"]) for v, lab in tail] == \
+        [(0.9, "b"), (0.5, "c")]
+    assert w.tail_exemplars(k=10, window_s=0.1, now=now) == []
+
+
+# ------------------------------------------------- heartbeat procfs fallback
+
+
+def test_heartbeat_degrades_without_procfs(log_path, monkeypatch, tmp_path):
+    """A host without procfs loses ONLY the rss gauges: the first failed
+    open memoizes unavailability (no per-beat reopen, no error spam)
+    and the heartbeat keeps beating."""
+    from raft_tpu.obs import heartbeat
+
+    monkeypatch.setattr(heartbeat, "PROC_STATUS_PATH",
+                        str(tmp_path / "no-procfs" / "status"))
+    monkeypatch.setattr(heartbeat, "_PROC_AVAILABLE", [True])
+    assert heartbeat.sample_host_rss() == (None, None)
+    assert heartbeat._PROC_AVAILABLE[0] is False
+    # memoized: even a now-readable path is not re-probed
+    ok = tmp_path / "status"
+    ok.write_text("VmRSS:\t    2048 kB\nVmHWM:\t    4096 kB\n")
+    monkeypatch.setattr(heartbeat, "PROC_STATUS_PATH", str(ok))
+    assert heartbeat.sample_host_rss() == (None, None)
+    # beats still sample devices/progress — just without the rss keys
+    hb = Heartbeat(0.02)
+    hb.beat()
+    (ev,) = _events(log_path, "heartbeat")
+    assert "host_rss_bytes" not in ev and "error" not in ev
+    assert "host_rss_bytes" not in metrics.snapshot().get("gauges", {})
+    # a fresh memo against a healthy status file parses VmRSS/VmHWM
+    monkeypatch.setattr(heartbeat, "_PROC_AVAILABLE", [True])
+    assert heartbeat.sample_host_rss() == (2048 * 1024, 4096 * 1024)

@@ -229,6 +229,55 @@ def test_request_stage_attribution_sums_to_total(serve_stack, tmp_path,
     assert metrics.histogram("serve_stage_solve_s").count == n0
 
 
+def test_request_exemplars_name_the_actual_request(serve_stack, tmp_path,
+                                                   monkeypatch):
+    """Tail exemplars ride the real dispatch path: the latency
+    histogram names the request (design hash, bucket signature, rows,
+    ids, replica), /metrics renders it in OpenMetrics exemplar syntax,
+    and ``obs report --tail`` joins it back to the stage breakdown by
+    span_id."""
+    from raft_tpu.obs import metrics
+    from raft_tpu.obs import report as obs_report
+
+    _, batcher = serve_stack
+    log = str(tmp_path / "ex_events.jsonl")
+    monkeypatch.setenv("RAFT_TPU_LOG", log)
+    metrics.reset()       # empty exemplar slots: both requests admit
+    ctx_a = ("feed" * 4, "cafe" * 4)
+    ctx_b = ("feed" * 4, "beef" * 4)
+    futs = [batcher.submit("spar", 3.75, 9.25, 0.05, trace_ctx=ctx_a),
+            batcher.submit("spar", 3.85, 9.75, 0.0, trace_ctx=ctx_b)]
+    batcher.run_tick()
+    for f in futs:
+        f.result(timeout=60)
+    # the histogram exemplar carries the request's full identity (the
+    # exporter keeps the best exemplar per bucket — both requests share
+    # a latency bucket, so the slower of the two is the one named)
+    ex = metrics.histogram("serve_request_s").exemplars()
+    labels = [lab for _, _, lab in ex.values()]
+    hit = next(lab for lab in labels if lab.get("trace_id") == "feed" * 4)
+    assert hit["span_id"] in (ctx_a[1], ctx_b[1])
+    assert hit["design"] and hit["sig"] and hit["rows"] == 2
+    assert hit["cache_hit"] == 0 and hit["status"] == 0
+    assert hit["replica"]
+    # OpenMetrics exemplar clause on the scrape
+    assert any("serve_request_s_bucket" in line and "# {" in line
+               for line in metrics.to_prometheus().splitlines())
+    # report --tail: the stages event carries the REQUEST's ids and
+    # the exemplar_recorded event joins by span_id
+    evs, bad = obs_report.read_events(log)
+    assert bad == 0
+    view = obs_report.tail_view(evs, rank=1.0)
+    assert view["n_requests"] == 2
+    assert view["span_id"] in (ctx_a[1], ctx_b[1])
+    assert view["trace_id"] == "feed" * 4
+    assert view["exemplar"]["span_id"] == view["span_id"]
+    assert view["exemplar"]["design"] == hit["design"]
+    assert view["stages"]["solve"] > 0
+    txt = obs_report.render_tail(evs, rank=1.0, source=log)
+    assert "design" in txt and "solve" in txt
+
+
 def test_slo_breach_window_and_healthz(serve_stack, monkeypatch):
     from raft_tpu.obs import metrics
     from raft_tpu.serve.http import Server
@@ -505,6 +554,9 @@ def test_server_end_to_end_sigterm_drain(tmp_path):
         RAFT_TPU_METRICS=str(metrics_path),
         RAFT_TPU_LOG=str(log_path),
         RAFT_TPU_CACHE_DIR=str(tmp_path / "jax_cache"),
+        # black-box flight recorder: periodic flush shards land here
+        RAFT_TPU_FLIGHT_DIR=str(tmp_path / "flight"),
+        RAFT_TPU_FLIGHT_FLUSH_S="0.5",
     )
     env.pop("RAFT_TPU_AOT", None)
     stderr_f = open(stderr_path, "w")
@@ -567,6 +619,17 @@ def test_server_end_to_end_sigterm_drain(tmp_path):
         assert code == 200
         assert "raft_tpu_serve_requests" in prom
         assert "raft_tpu_serve_batch_occupancy_bucket" in prom
+        # OpenMetrics exemplars on the scrape: the latency buckets NAME
+        # the actual requests that landed in them
+        assert any("raft_tpu_serve_request_s_bucket" in line
+                   and "# {" in line for line in prom.splitlines())
+        # the loopback-gated flight-ring dump: a JSONL body whose first
+        # line is the schema-versioned proc_start anchor
+        code, box = c.request("GET", "/debug/flight")
+        assert code == 200 and isinstance(box, str)
+        first = json.loads(box.splitlines()[0])
+        assert first["event"] == "proc_start"
+        assert first["flight"]["trigger"] == "debug"
         code, designs = c.request("GET", "/designs")
         assert code == 200 and designs["designs"] == ["spar"]
         # unknown design -> 404, bad body -> 400
@@ -619,6 +682,28 @@ def test_server_end_to_end_sigterm_drain(tmp_path):
         names = {e["event"] for e in events}
         assert {"serve_start", "serve_tick", "serve_request",
                 "serve_drain", "serve_stop"} <= names
+        # the flight recorder left its stable flush shard behind, and
+        # it validates against the strict schema reader
+        from raft_tpu.obs import flight
+
+        shard = tmp_path / "flight" / f"flight-{proc.pid}.jsonl"
+        assert shard.exists(), "no flight flush shard after shutdown"
+        hdr, _recs = flight.read_shard(str(shard))
+        assert hdr["flight"]["version"] == flight.SCHEMA_VERSION
+        # report --tail on the capture: the slowest request joins its
+        # exemplar identity AND its span tree (dispatched via HTTP, so
+        # the serve_request span carries the stage events' ids)
+        from raft_tpu.obs.report import tail_view
+
+        view = tail_view(events, rank=1.0)
+        assert view is not None and view["n_requests"] >= 12
+        assert view["trace_id"] and view["span_id"]
+        assert view["exemplar"] is not None
+        assert view["exemplar"]["span_id"] == view["span_id"]
+        assert view["exemplar"]["design"] and view["exemplar"]["replica"]
+        assert view["spans"], "p100 request has no span tree"
+        assert any(s["name"] == "serve_request" for s in view["spans"])
+        assert view["stages"]["solve"] > 0
     finally:
         if proc.poll() is None:
             proc.kill()
